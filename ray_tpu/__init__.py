@@ -1,0 +1,55 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+A ground-up re-design of the reference system (Ray) for TPU hardware:
+tasks/actors/objects on a shared-memory core, a pod-slice-topology-aware
+scheduler, and JAX/XLA-first libraries (data, train, tune, rl, serve) whose
+collectives compile into XLA programs over the ICI mesh instead of NCCL.
+"""
+
+from ._version import version as __version__  # noqa: F401
+from . import exceptions  # noqa: F401
+from .api import (  # noqa: F401
+    ObjectRef,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    method,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from .core.placement_group import (  # noqa: F401
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    remove_placement_group,
+)
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "method",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "cluster_resources",
+    "available_resources",
+    "nodes",
+    "ObjectRef",
+    "placement_group",
+    "remove_placement_group",
+    "PlacementGroupSchedulingStrategy",
+    "exceptions",
+]

@@ -1,0 +1,77 @@
+"""ObjectRef: a future-like handle to a task output or put object.
+
+Re-design of the reference's ObjectRef (reference:
+python/ray/_raylet.pyx ObjectRef, src/ray/core_worker/reference_count.h):
+ownership is tracked by the submitting process; dropping the last local
+reference releases the object from the owner's stores.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import TYPE_CHECKING, Optional
+
+from .ids import ObjectID
+
+if TYPE_CHECKING:
+    from .runtime_base import Runtime
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_runtime", "_owner_addr", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, runtime: Optional["Runtime"] = None, owner_addr: str = ""):
+        self._id = object_id
+        self._owner_addr = owner_addr
+        if runtime is None:
+            from . import runtime_base
+
+            runtime = runtime_base.maybe_runtime()
+        self._runtime = runtime
+        if self._runtime is not None:
+            self._runtime.add_local_ref(self._id)
+
+    def id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def future(self) -> concurrent.futures.Future:
+        """Returns a concurrent.futures.Future resolving to the object value."""
+        assert self._runtime is not None
+        return self._runtime.object_future(self._id)
+
+    def __await__(self):
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()
+
+    def __del__(self):
+        rt = self._runtime
+        if rt is not None:
+            try:
+                rt.remove_local_ref(self._id)
+            except Exception:
+                pass
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self.hex()[:16]})"
+
+    def __reduce__(self):
+        # Serializing a ref inside task args/returns transfers a borrow; the
+        # receiving process re-binds it to its own runtime on deserialization.
+        return (ObjectRef._from_wire, (self._id.binary(), self._owner_addr))
+
+    @staticmethod
+    def _from_wire(id_bytes: bytes, owner_addr: str) -> "ObjectRef":
+        return ObjectRef(ObjectID(id_bytes), owner_addr=owner_addr)

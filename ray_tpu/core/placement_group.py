@@ -1,0 +1,72 @@
+"""Placement groups: gang reservation of resource bundles.
+
+API analogue of the reference's placement groups
+(reference: python/ray/util/placement_group.py:145, bundle policies at
+src/ray/raylet/scheduling/policy/bundle_scheduling_policy.h). Strategies:
+PACK, SPREAD, STRICT_PACK, STRICT_SPREAD, plus the TPU-native addition
+SLICE_GANG — one bundle per host of a pod slice, leased atomically
+(replaces the reference's TPU-{pod}-head custom-resource idiom,
+python/ray/_private/accelerators/tpu.py:334-397).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD", "SLICE_GANG")
+
+
+@dataclass
+class PlacementGroupHandle:
+    id_hex: str
+    bundles: List[Dict[str, float]]
+    strategy: str = "PACK"
+    name: str = ""
+    # bundle_index -> node_id, filled once scheduled
+    bundle_placements: Dict[int, str] = field(default_factory=dict)
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundles)
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        from .runtime_base import current_runtime
+
+        return current_runtime().placement_group_ready(self.id_hex, timeout=timeout)
+
+    def wait(self, timeout_seconds: Optional[float] = None) -> bool:
+        return self.ready(timeout=timeout_seconds)
+
+    def __repr__(self):
+        return f"PlacementGroup({self.id_hex[:12]}, {self.strategy}, {len(self.bundles)} bundles)"
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+) -> PlacementGroupHandle:
+    if strategy not in STRATEGIES:
+        raise ValueError(f"unknown strategy {strategy!r}; expected one of {STRATEGIES}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty dicts")
+    from .runtime_base import current_runtime
+
+    return current_runtime().create_placement_group(bundles, strategy, name)
+
+
+def remove_placement_group(pg: PlacementGroupHandle) -> None:
+    from .runtime_base import current_runtime
+
+    current_runtime().remove_placement_group(pg.id_hex)
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    """Mirror of the reference's scheduling_strategies.PlacementGroupSchedulingStrategy
+    (reference: python/ray/util/scheduling_strategies.py)."""
+
+    placement_group: PlacementGroupHandle
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False

@@ -1,0 +1,180 @@
+"""Cluster resource model with first-class TPU topology.
+
+Re-design of the reference's scheduling resource model
+(reference: src/ray/common/scheduling/cluster_resource_data.h,
+fixed_point.h, resource_instance_set.h). Differences, per the TPU-first
+design brief (SURVEY.md §2a note):
+
+* Quantities are fixed-point integers (1/10000 granularity) exactly like the
+  reference, so fractional resources round-trip without float drift.
+* ``TPU`` is a first-class resource, and a node may additionally carry a
+  :class:`TpuSliceSpec` describing accelerator topology (version, chips per
+  host, hosts per slice, slice name). The scheduler uses it for atomic
+  slice-gang leases, replacing the reference's ``TPU-{pod}-head`` custom
+  resource idiom (reference: python/ray/_private/accelerators/tpu.py:334-397).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+PRECISION = 10000
+
+CPU = "CPU"
+TPU = "TPU"
+GPU = "GPU"  # accepted for API parity; never auto-detected
+MEMORY = "memory"
+OBJECT_STORE_MEMORY = "object_store_memory"
+
+_IMPLICIT_PREFIX = "node:"
+
+
+def to_fixed(v: float) -> int:
+    return round(v * PRECISION)
+
+
+def from_fixed(v: int) -> float:
+    return v / PRECISION
+
+
+@dataclass(frozen=True)
+class TpuSliceSpec:
+    """Topology of the TPU slice a node belongs to.
+
+    A v5e-64 slice, for example, is 16 hosts x 4 chips. All hosts of one
+    slice share ``slice_name``; gang scheduling leases them atomically so an
+    SPMD program always sees the full mesh.
+    """
+
+    version: str = "v5e"          # v4 | v5e | v5p | v6e ...
+    slice_name: str = ""           # unique per physical slice
+    topology: str = ""             # e.g. "8x8" (chip grid over the slice)
+    chips_per_host: int = 4
+    hosts_per_slice: int = 1
+    worker_index: int = 0          # this host's index within the slice
+
+    @property
+    def total_chips(self) -> int:
+        return self.chips_per_host * self.hosts_per_slice
+
+
+class ResourceSet:
+    """A bag of named resource quantities (fixed-point internally)."""
+
+    __slots__ = ("_map",)
+
+    def __init__(self, resources: Optional[Dict[str, float]] = None):
+        self._map: Dict[str, int] = {}
+        for k, v in (resources or {}).items():
+            if v < 0:
+                raise ValueError(f"negative resource {k}={v}")
+            fx = to_fixed(v)
+            if fx > 0:
+                self._map[k] = fx
+
+    @classmethod
+    def _from_fixed_map(cls, m: Dict[str, int]) -> "ResourceSet":
+        rs = cls()
+        rs._map = {k: v for k, v in m.items() if v > 0}
+        return rs
+
+    def to_dict(self) -> Dict[str, float]:
+        return {k: from_fixed(v) for k, v in self._map.items()}
+
+    def get(self, name: str) -> float:
+        return from_fixed(self._map.get(name, 0))
+
+    def is_empty(self) -> bool:
+        return not self._map
+
+    def is_subset_of(self, other: "ResourceSet") -> bool:
+        return all(other._map.get(k, 0) >= v for k, v in self._map.items())
+
+    def __add__(self, other: "ResourceSet") -> "ResourceSet":
+        m = dict(self._map)
+        for k, v in other._map.items():
+            m[k] = m.get(k, 0) + v
+        return ResourceSet._from_fixed_map(m)
+
+    def __sub__(self, other: "ResourceSet") -> "ResourceSet":
+        m = dict(self._map)
+        for k, v in other._map.items():
+            m[k] = m.get(k, 0) - v
+            if m[k] < 0:
+                raise ValueError(f"resource {k} went negative")
+        return ResourceSet._from_fixed_map(m)
+
+    def __eq__(self, other):
+        return isinstance(other, ResourceSet) and self._map == other._map
+
+    def __repr__(self):
+        return f"ResourceSet({self.to_dict()})"
+
+
+@dataclass
+class NodeResources:
+    """Total + available resources of one node, plus TPU topology."""
+
+    node_id: str
+    total: ResourceSet
+    available: ResourceSet
+    tpu_slice: Optional[TpuSliceSpec] = None
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    def can_fit(self, request: ResourceSet) -> bool:
+        return request.is_subset_of(self.available)
+
+    def could_ever_fit(self, request: ResourceSet) -> bool:
+        return request.is_subset_of(self.total)
+
+    def acquire(self, request: ResourceSet) -> None:
+        self.available = self.available - request
+
+    def release(self, request: ResourceSet) -> None:
+        self.available = self.available + request
+
+
+def task_resources(
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    num_gpus: Optional[float] = None,
+    memory: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    default_num_cpus: float = 1.0,
+) -> ResourceSet:
+    """Builds the resource request for one task/actor invocation, mirroring
+    the reference's option normalization (python/ray/_private/ray_option_utils.py)."""
+    req: Dict[str, float] = dict(resources or {})
+    req[CPU] = default_num_cpus if num_cpus is None else num_cpus
+    if num_tpus:
+        req[TPU] = num_tpus
+    if num_gpus:
+        req[GPU] = num_gpus
+    if memory:
+        req[MEMORY] = memory
+    return ResourceSet(req)
+
+
+def detect_node_resources(
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    object_store_memory: Optional[int] = None,
+) -> Dict[str, float]:
+    """Autodetects this host's resources (CPUs via os, TPU chips via jax if
+    importable without initializing a backend; falls back to /dev/accel*,
+    the same probe the reference uses at python/ray/_private/accelerators/tpu.py:98)."""
+    import os
+
+    res: Dict[str, float] = {}
+    res[CPU] = float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))
+    if num_tpus is not None:
+        if num_tpus:
+            res[TPU] = float(num_tpus)
+    else:
+        n_accel = len([d for d in os.listdir("/dev") if d.startswith("accel")]) if os.path.isdir("/dev") else 0
+        if n_accel:
+            res[TPU] = float(n_accel)
+    if object_store_memory:
+        res[OBJECT_STORE_MEMORY] = float(object_store_memory)
+    return res

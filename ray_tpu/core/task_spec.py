@@ -1,0 +1,102 @@
+"""Task and actor specifications passed from caller to executor.
+
+Condensed re-design of the reference's TaskSpecification
+(reference: src/ray/common/task/task_spec.h, protobuf common.proto TaskSpec):
+one dataclass covers normal tasks, actor creation, and actor calls. Function
+payloads travel as cloudpickle bytes; a per-process function table caches
+deserialized callables keyed by content hash (mirroring the reference's GCS
+function table, reference: python/ray/_private/function_manager.py).
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from .ids import ActorID, ObjectID, PlacementGroupID, TaskID
+from .resources import ResourceSet
+
+
+class TaskType(enum.Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION = 1
+    ACTOR_TASK = 2
+
+
+@dataclass
+class SchedulingOptions:
+    resources: ResourceSet = field(default_factory=ResourceSet)
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    scheduling_strategy: str = "DEFAULT"   # DEFAULT | SPREAD | NODE:<id>
+    max_concurrency: int = 1               # actors only
+    max_restarts: int = 0                  # actors only
+    name: Optional[str] = None             # named actor
+    namespace: Optional[str] = None
+    lifetime: Optional[str] = None         # None | "detached"
+    runtime_env: Optional[dict] = None
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    task_type: TaskType
+    func_blob: bytes                      # cloudpickle of fn / actor class
+    func_hash: str
+    method_name: str                      # "" for normal tasks; "__init__" for creation
+    args: Tuple[Any, ...]                 # values or ObjectID placeholders (see ArgRef)
+    kwargs: Dict[str, Any]
+    num_returns: int
+    options: SchedulingOptions
+    actor_id: Optional[ActorID] = None
+    return_ids: List[ObjectID] = field(default_factory=list)
+    attempt: int = 0
+
+    def description(self) -> str:
+        if self.task_type == TaskType.ACTOR_TASK:
+            return f"actor task {self.method_name} ({self.task_id.hex()[:8]})"
+        if self.task_type == TaskType.ACTOR_CREATION:
+            return f"actor creation ({self.actor_id.hex()[:8] if self.actor_id else '?'})"
+        return f"task {self.method_name or 'fn'} ({self.task_id.hex()[:8]})"
+
+
+@dataclass(frozen=True)
+class ArgRef:
+    """Placeholder inside TaskSpec.args/kwargs marking an ObjectID dependency
+    to be resolved by the executor (reference: DependencyResolver,
+    src/ray/core_worker/transport/dependency_resolver.h)."""
+
+    object_id: ObjectID
+
+
+class FunctionTable:
+    """Content-addressed cache of deserialized task functions."""
+
+    def __init__(self):
+        self._cache: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def dumps(fn: Any) -> Tuple[bytes, str]:
+        blob = cloudpickle.dumps(fn)
+        return blob, hashlib.sha256(blob).hexdigest()
+
+    def loads(self, blob: bytes, func_hash: str) -> Any:
+        with self._lock:
+            hit = self._cache.get(func_hash)
+        if hit is not None:
+            return hit
+        fn = cloudpickle.loads(blob)
+        with self._lock:
+            self._cache[func_hash] = fn
+        return fn
+
+
+GLOBAL_FUNCTION_TABLE = FunctionTable()

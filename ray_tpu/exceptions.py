@@ -1,0 +1,77 @@
+"""User-visible exception types.
+
+Mirrors the reference's exception taxonomy (reference:
+python/ray/exceptions.py) at the granularity the TPU runtime needs.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at `get` on the caller, with the
+    remote traceback attached (reference: python/ray/exceptions.py RayTaskError)."""
+
+    def __init__(self, cause: BaseException, remote_tb: Optional[str] = None, task_desc: str = ""):
+        self.cause = cause
+        self.remote_tb = remote_tb or "".join(
+            traceback.format_exception(type(cause), cause, cause.__traceback__)
+        )
+        self.task_desc = task_desc
+        super().__init__(str(cause))
+
+    def __str__(self):
+        return (
+            f"{type(self.cause).__name__}: {self.cause}\n"
+            f"--- remote traceback ({self.task_desc}) ---\n{self.remote_tb}"
+        )
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    def __init__(self, actor_id_hex: str = "", reason: str = "actor died"):
+        self.actor_id_hex = actor_id_hex
+        self.reason = reason
+        super().__init__(f"Actor {actor_id_hex[:12]} died: {reason}")
+
+
+class ActorUnavailableError(ActorError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id_hex: str = ""):
+        super().__init__(f"Object {object_id_hex[:12]} was lost and could not be reconstructed")
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PlacementGroupError(RayTpuError):
+    pass

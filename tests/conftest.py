@@ -1,0 +1,39 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh (mirrors the reference's
+single-machine multi-node test strategy, reference:
+python/ray/tests/conftest.py ray_start_cluster / cluster_utils.Cluster) so
+multi-chip sharding logic is exercised without TPU hardware.
+"""
+
+import os
+
+# Must run before the first `import jax` anywhere in the test session.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+
+@pytest.fixture
+def rt_local():
+    """An initialized local-mode runtime (analogue of ray_start_regular)."""
+    import ray_tpu as rt
+
+    rt.shutdown()
+    rt.init(local_mode=True, num_cpus=8)
+    yield rt
+    rt.shutdown()
+
+
+@pytest.fixture
+def rt_cluster():
+    """An initialized single-node multi-process cluster."""
+    import ray_tpu as rt
+
+    rt.shutdown()
+    rt.init(num_cpus=4, num_workers=2)
+    yield rt
+    rt.shutdown()

@@ -1,0 +1,213 @@
+"""Core API tests: tasks, objects, actors in local mode.
+
+Modeled on the reference's core smoke tests
+(reference: python/ray/tests/test_basic.py, test_actor.py).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.exceptions import ActorDiedError, GetTimeoutError, TaskError
+
+
+def test_put_get(rt_local):
+    ref = rt.put(42)
+    assert rt.get(ref) == 42
+    arr = np.arange(100000, dtype=np.float32)
+    ref2 = rt.put(arr)
+    np.testing.assert_array_equal(rt.get(ref2), arr)
+
+
+def test_simple_task(rt_local):
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    assert rt.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_options(rt_local):
+    @rt.remote(num_cpus=2)
+    def f():
+        return "ok"
+
+    assert rt.get(f.options(num_cpus=1).remote()) == "ok"
+
+
+def test_task_dependencies(rt_local):
+    @rt.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(10):
+        ref = inc.remote(ref)
+    assert rt.get(ref) == 11
+
+
+def test_object_ref_args_mixed(rt_local):
+    @rt.remote
+    def combine(a, b, c=0):
+        return a + b + c
+
+    assert rt.get(combine.remote(rt.put(1), 2, c=rt.put(3))) == 6
+
+
+def test_multiple_returns(rt_local):
+    @rt.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert rt.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(rt_local):
+    @rt.remote
+    def boom():
+        raise ValueError("kapow")
+
+    with pytest.raises(TaskError, match="kapow"):
+        rt.get(boom.remote())
+
+    @rt.remote
+    def dependent(x):
+        return x
+
+    # Errors flow through dependencies, like the reference's RayTaskError.
+    with pytest.raises(TaskError, match="kapow"):
+        rt.get(dependent.remote(boom.remote()))
+
+
+def test_get_timeout(rt_local):
+    @rt.remote
+    def slow():
+        time.sleep(5)
+        return 1
+
+    with pytest.raises(GetTimeoutError):
+        rt.get(slow.remote(), timeout=0.1)
+
+
+def test_wait(rt_local):
+    @rt.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.01)
+    slow = sleepy.remote(5.0)
+    ready, pending = rt.wait([fast, slow], num_returns=1, timeout=2.0)
+    assert ready == [fast] and pending == [slow]
+
+
+def test_actor_basic(rt_local):
+    @rt.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    refs = [c.inc.remote() for _ in range(5)]
+    assert rt.get(refs) == [11, 12, 13, 14, 15]  # FIFO ordering
+    assert rt.get(c.value.remote()) == 15
+
+
+def test_actor_error_and_death(rt_local):
+    @rt.remote
+    class A:
+        def ok(self):
+            return 1
+
+        def fail(self):
+            raise RuntimeError("nope")
+
+    a = A.remote()
+    with pytest.raises(TaskError, match="nope"):
+        rt.get(a.fail.remote())
+    assert rt.get(a.ok.remote()) == 1  # survives method errors
+
+    rt.kill(a)
+    with pytest.raises(ActorDiedError):
+        rt.get(a.ok.remote())
+
+
+def test_named_actor(rt_local):
+    @rt.remote
+    class Registry:
+        def ping(self):
+            return "pong"
+
+    Registry.options(name="reg").remote()
+    h = rt.get_actor("reg")
+    assert rt.get(h.ping.remote()) == "pong"
+    with pytest.raises(ValueError):
+        rt.get_actor("missing")
+
+
+def test_actor_handle_passing(rt_local):
+    @rt.remote
+    class Store:
+        def __init__(self):
+            self.v = {}
+
+        def set(self, k, v):
+            self.v[k] = v
+            return True
+
+        def get(self, k):
+            return self.v.get(k)
+
+    @rt.remote
+    def writer(store, k, v):
+        return rt.get(store.set.remote(k, v))
+
+    s = Store.remote()
+    assert rt.get(writer.remote(s, "x", 99))
+    assert rt.get(s.get.remote("x")) == 99
+
+
+def test_nested_tasks(rt_local):
+    @rt.remote
+    def leaf(x):
+        return x * 2
+
+    @rt.remote
+    def parent(x):
+        return rt.get(leaf.remote(x)) + 1
+
+    assert rt.get(parent.remote(10)) == 21
+
+
+def test_cluster_resources(rt_local):
+    res = rt.cluster_resources()
+    assert res["CPU"] == 8
+
+
+def test_reinit_guard(rt_local):
+    with pytest.raises(RuntimeError):
+        rt.init(local_mode=True)
+    rt.init(local_mode=True, ignore_reinit_error=True)
+
+
+def test_actor_max_concurrency(rt_local):
+    @rt.remote(max_concurrency=4)
+    class Par:
+        def slow(self):
+            time.sleep(0.2)
+            return 1
+
+    p = Par.remote()
+    t0 = time.monotonic()
+    rt.get([p.slow.remote() for _ in range(4)])
+    assert time.monotonic() - t0 < 0.7  # ran concurrently
