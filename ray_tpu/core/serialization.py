@@ -73,9 +73,10 @@ def packed_size(meta: bytes, buffers: List[memoryview]) -> int:
     return 4 + 8 + len(meta) + sum(8 + b.nbytes for b in buffers)
 
 
-def unpack(data) -> Any:
-    """Inverse of pack. Accepts bytes or a memoryview (zero-copy: out-of-band
-    buffers are sub-views of `data`, so numpy arrays alias the source)."""
+def unpack_info(data) -> Tuple[Any, int]:
+    """Inverse of pack; returns (value, n_out_of_band_buffers). Accepts bytes
+    or a memoryview (zero-copy: out-of-band buffers are sub-views of `data`,
+    so numpy arrays alias — and keep alive — the source buffer)."""
     view = memoryview(data)
     n_buffers = int.from_bytes(view[:4], "little")
     len_meta = int.from_bytes(view[4:12], "little")
@@ -88,4 +89,8 @@ def unpack(data) -> Any:
         off += 8
         buffers.append(view[off : off + blen])
         off += blen
-    return deserialize(meta, buffers)
+    return deserialize(meta, buffers), n_buffers
+
+
+def unpack(data) -> Any:
+    return unpack_info(data)[0]

@@ -1,0 +1,257 @@
+"""Python client for the native shared-memory object pool.
+
+Analogue of the reference's plasma client (reference:
+src/ray/object_manager/plasma/client.h, python binding in _raylet.pyx):
+put serializes directly into pool memory; get returns values whose large
+buffers (numpy arrays) alias pool memory zero-copy, pinned until the last
+Python reference to them drops (PEP-688 buffer wrapper replaces plasma's
+client-side object-in-use tracking).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import errno
+import mmap
+import os
+import time
+from typing import Any, Optional
+
+from .. import exceptions as exc
+from ..native.build import shm_pool_lib
+from . import serialization
+from .ids import ObjectID
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(shm_pool_lib())
+        lib.rtpu_pool_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.rtpu_pool_create.restype = ctypes.c_int
+        lib.rtpu_pool_attach.argtypes = [ctypes.c_char_p]
+        lib.rtpu_pool_attach.restype = ctypes.c_int
+        lib.rtpu_create.argtypes = [
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.rtpu_create.restype = ctypes.c_int
+        for f in ("rtpu_seal", "rtpu_contains", "rtpu_release", "rtpu_delete"):
+            fn = getattr(lib, f)
+            fn.argtypes = [ctypes.c_int, ctypes.c_char_p]
+            fn.restype = ctypes.c_int
+        lib.rtpu_get.argtypes = [
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.rtpu_get.restype = ctypes.c_int
+        for f in ("rtpu_bytes_in_use", "rtpu_num_objects", "rtpu_capacity"):
+            fn = getattr(lib, f)
+            fn.argtypes = [ctypes.c_int]
+            fn.restype = ctypes.c_uint64
+        lib.rtpu_pool_detach.argtypes = [ctypes.c_int]
+        lib.rtpu_pool_detach.restype = ctypes.c_int
+        _lib = lib
+    return _lib
+
+
+class _Pin:
+    """Keeps a pool object pinned while any deserialized buffer aliases it.
+
+    Supports the buffer protocol (PEP 688) so it can back PickleBuffers;
+    numpy arrays reconstructed from it hold a reference chain
+    array -> memoryview -> _Pin, and the pin is released when that chain dies.
+    """
+
+    __slots__ = ("_store", "_key", "_view", "_released", "__weakref__")
+
+    def __init__(self, store: "SharedMemoryStore", key: bytes, view: memoryview):
+        self._store = store
+        self._key = key
+        self._view = view
+        self._released = False
+
+    def __buffer__(self, flags: int) -> memoryview:
+        return self._view
+
+    def slice(self, start: int, stop: int) -> memoryview:
+        return self._view[start:stop]
+
+    def release(self):
+        if not self._released:
+            self._released = True
+            self._store._release(self._key)
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
+class SharedMemoryStore:
+    """One node's shared object pool; every local process attaches to it."""
+
+    DEFAULT_CAPACITY = 2 << 30
+
+    def __init__(self, path: str):
+        self._path = path
+        self._lib = _load()
+        self._handle = self._lib.rtpu_pool_attach(path.encode())
+        if self._handle < 0:
+            raise OSError(-self._handle, f"failed to attach pool at {path}")
+        fd = os.open(path, os.O_RDWR)
+        try:
+            self._map = mmap.mmap(fd, 0)
+        finally:
+            os.close(fd)
+        self._mv = memoryview(self._map)
+        self._closed = False
+
+    # ----------------------------------------------------------------- admin
+    @classmethod
+    def create(cls, path: str, capacity: int = DEFAULT_CAPACITY) -> "SharedMemoryStore":
+        rc = _load().rtpu_pool_create(path.encode(), capacity)
+        if rc != 0 and rc != -errno.EEXIST:
+            raise OSError(-rc, f"failed to create pool at {path}")
+        # On -EEXIST another process won the O_EXCL race and may still be
+        # initializing (magic is written last); retry attach briefly.
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                return cls(path)
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.01)
+
+    def close(self):
+        if self._handle < 0 or self._closed:
+            return
+        self._closed = True
+        try:
+            self._mv.release()
+            self._map.close()
+        except BufferError:
+            # Zero-copy arrays from get() are still alive and alias the map.
+            # Leave the mapping and handle in place so their pins can still
+            # release; the OS reclaims everything at process exit.
+            return
+        self._lib.rtpu_pool_detach(self._handle)
+        self._handle = -1
+
+    # ------------------------------------------------------------------- put
+    def put(self, oid: ObjectID, value: Any) -> None:
+        meta, buffers = serialization.serialize(value)
+        size = serialization.packed_size(meta, buffers)
+        off = ctypes.c_uint64()
+        rc = self._lib.rtpu_create(self._handle, oid.binary(), size, ctypes.byref(off))
+        if rc == -errno.EEXIST:
+            return  # idempotent: object already present
+        if rc == -errno.ENOMEM:
+            raise exc.ObjectStoreFullError(
+                f"object of {size} bytes does not fit (in use: {self.bytes_in_use()}"
+                f" / {self.capacity()})"
+            )
+        if rc != 0:
+            raise OSError(-rc, "rtpu_create failed")
+        dst = self._mv[off.value : off.value + size]
+        # Write the framed payload directly into pool memory (one copy).
+        pos = 0
+        dst[pos : pos + 4] = len(buffers).to_bytes(4, "little")
+        pos += 4
+        dst[pos : pos + 8] = len(meta).to_bytes(8, "little")
+        pos += 8
+        dst[pos : pos + len(meta)] = meta
+        pos += len(meta)
+        for b in buffers:
+            dst[pos : pos + 8] = b.nbytes.to_bytes(8, "little")
+            pos += 8
+            flat = b.cast("B") if b.ndim != 1 or b.format != "B" else b
+            dst[pos : pos + flat.nbytes] = flat
+            pos += flat.nbytes
+        del dst
+        self._lib.rtpu_seal(self._handle, oid.binary())
+
+    def put_raw(self, oid: ObjectID, data: bytes) -> None:
+        """Stores pre-framed bytes (used by the transfer path)."""
+        off = ctypes.c_uint64()
+        rc = self._lib.rtpu_create(self._handle, oid.binary(), len(data), ctypes.byref(off))
+        if rc == -errno.EEXIST:
+            return
+        if rc == -errno.ENOMEM:
+            raise exc.ObjectStoreFullError(f"object of {len(data)} bytes does not fit")
+        if rc != 0:
+            raise OSError(-rc, "rtpu_create failed")
+        self._mv[off.value : off.value + len(data)] = data
+        self._lib.rtpu_seal(self._handle, oid.binary())
+
+    # ------------------------------------------------------------------- get
+    def get(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
+        """Fetches and deserializes; with a timeout, waits for a concurrent
+        writer to create+seal the object. timeout=None raises KeyError
+        immediately when absent (the runtime layer waits on task futures
+        before calling get, so absent normally means lost)."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            rc = self._lib.rtpu_get(self._handle, oid.binary(), ctypes.byref(off), ctypes.byref(size))
+            if rc == 0:
+                break
+            if rc in (-errno.ENOENT, -errno.EAGAIN):
+                if rc == -errno.ENOENT and deadline is None:
+                    raise KeyError(oid.hex())
+                if deadline is not None and time.monotonic() > deadline:
+                    if rc == -errno.ENOENT:
+                        raise KeyError(oid.hex())
+                    raise exc.GetTimeoutError(f"object {oid.hex()[:12]} never sealed")
+                time.sleep(0.0002)
+                continue
+            raise OSError(-rc, "rtpu_get failed")
+        # Readers get read-only views: pool objects are immutable after seal.
+        pin = _Pin(self, oid.binary(), self._mv[off.value : off.value + size.value].toreadonly())
+        value, n_oob = serialization.unpack_info(memoryview(pin))
+        if n_oob == 0:
+            pin.release()  # nothing aliases the pool; unpin now
+        return value
+
+    def get_raw(self, oid: ObjectID) -> Optional[bytes]:
+        """Copies the framed payload out (used by the transfer path)."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rtpu_get(self._handle, oid.binary(), ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        try:
+            return bytes(self._mv[off.value : off.value + size.value])
+        finally:
+            self._lib.rtpu_release(self._handle, oid.binary())
+
+    # --------------------------------------------------------------- manage
+    def contains(self, oid: ObjectID) -> bool:
+        return self._lib.rtpu_contains(self._handle, oid.binary()) == 1
+
+    def _release(self, key: bytes) -> None:
+        if self._handle >= 0:
+            self._lib.rtpu_release(self._handle, key)
+
+    def delete(self, oid: ObjectID) -> bool:
+        """Returns True if freed now; False if pinned (caller retries later)."""
+        rc = self._lib.rtpu_delete(self._handle, oid.binary())
+        return rc == 0
+
+    def bytes_in_use(self) -> int:
+        return self._lib.rtpu_bytes_in_use(self._handle)
+
+    def num_objects(self) -> int:
+        return self._lib.rtpu_num_objects(self._handle)
+
+    def capacity(self) -> int:
+        return self._lib.rtpu_capacity(self._handle)

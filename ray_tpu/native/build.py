@@ -1,0 +1,40 @@
+"""On-demand build of the native components.
+
+The reference ships its native runtime prebuilt via bazel into the wheel
+(reference: BUILD.bazel, python/ray/_raylet.so); here the C++ sources are
+compiled once at first import with g++ and cached next to the sources.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_NATIVE_DIR, "_build")
+_lock = threading.Lock()
+
+
+def build_library(name: str, sources: list[str], extra_flags: list[str] | None = None) -> str:
+    """Compiles `sources` into lib<name>.so if stale; returns the .so path."""
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    out = os.path.join(_BUILD_DIR, f"lib{name}.so")
+    srcs = [os.path.join(_NATIVE_DIR, s) for s in sources]
+    with _lock:
+        if os.path.exists(out) and all(
+            os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs
+        ):
+            return out
+        cmd = (
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", out]
+            + srcs
+            + ["-lpthread"]
+            + (extra_flags or [])
+        )
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return out
+
+
+def shm_pool_lib() -> str:
+    return build_library("shm_pool", ["shm_pool.cc"])

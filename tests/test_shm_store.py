@@ -1,0 +1,113 @@
+"""Native shared-memory object pool tests (analogue of the reference's
+plasma tests, src/ray/object_manager/plasma/ + python/ray/tests/test_object_store*).
+"""
+
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.ids import TaskID
+from ray_tpu.core.shm_store import SharedMemoryStore
+from ray_tpu.exceptions import ObjectStoreFullError
+
+
+def _oid():
+    return TaskID.for_task().object_id_for_return(0)
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = SharedMemoryStore.create(str(tmp_path / "pool"), capacity=64 << 20)
+    yield s
+    s.close()
+
+
+def test_put_get_roundtrip(store):
+    oid = _oid()
+    store.put(oid, {"a": 1, "b": [1, 2, 3], "s": "hello"})
+    assert store.get(oid) == {"a": 1, "b": [1, 2, 3], "s": "hello"}
+
+
+def test_numpy_zero_copy(store):
+    oid = _oid()
+    arr = np.arange(1 << 20, dtype=np.float32)
+    store.put(oid, arr)
+    out = store.get(oid)
+    np.testing.assert_array_equal(out, arr)
+    # The returned array aliases pool memory (no copy): while it lives, the
+    # object is pinned and cannot be deleted.
+    assert not store.delete(oid)
+    del out
+    assert store.delete(oid)
+
+
+def test_get_returns_readonly_views(store):
+    oid = _oid()
+    store.put(oid, np.arange(1 << 20, dtype=np.float32))
+    out = store.get(oid)
+    with pytest.raises((ValueError, TypeError)):
+        out[0] = 42  # sealed objects are immutable for readers
+
+
+def test_idempotent_put(store):
+    oid = _oid()
+    store.put(oid, 1)
+    store.put(oid, 2)  # duplicate create is a no-op, first value wins
+    assert store.get(oid) == 1
+
+
+def test_missing_object(store):
+    with pytest.raises(KeyError):
+        store.get(_oid())
+
+
+def test_store_full_and_reuse(store):
+    oid = _oid()
+    big = np.zeros(48 << 20, dtype=np.uint8)
+    store.put(oid, big)
+    with pytest.raises(ObjectStoreFullError):
+        store.put(_oid(), np.zeros(48 << 20, dtype=np.uint8))
+    assert store.delete(oid)
+    # After free+coalesce the space is reusable.
+    oid2 = _oid()
+    store.put(oid2, np.zeros(48 << 20, dtype=np.uint8))
+    assert store.get(oid2).nbytes == 48 << 20
+
+
+def test_many_objects_alloc_free(store):
+    oids = []
+    for i in range(200):
+        oid = _oid()
+        store.put(oid, np.full(1000, i, dtype=np.int32))
+        oids.append(oid)
+    for i, oid in enumerate(reversed(oids)):
+        val = store.get(oid)
+        assert val[0] == len(oids) - 1 - i
+        del val
+        assert store.delete(oid)
+    assert store.num_objects() == 0
+
+
+def _child_reader(path, oid_bytes, q):
+    from ray_tpu.core.ids import ObjectID
+
+    s = SharedMemoryStore(path)
+    val = s.get(ObjectID(oid_bytes), timeout=5)
+    q.put(float(val.sum()))
+    del val
+    s.close()
+
+
+def test_cross_process_get(store, tmp_path):
+    oid = _oid()
+    arr = np.ones(100000, dtype=np.float64)
+    store.put(oid, arr)
+    ctx = multiprocessing.get_context("fork")
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_reader, args=(str(tmp_path / "pool"), oid.binary(), q))
+    p.start()
+    assert q.get(timeout=20) == 100000.0
+    p.join(timeout=10)
+    assert p.exitcode == 0
