@@ -1,0 +1,45 @@
+"""TPU-first parallelism primitives: meshes, shardings, collectives,
+sequence parallelism. See SURVEY.md §2h for the mapping from the
+reference's NCCL/torch.distributed strategy inventory to these modules."""
+
+from .mesh import (
+    AXIS_ORDER,
+    MeshSpec,
+    TpuTopology,
+    build_mesh,
+    mesh_shape,
+    named_sharding,
+    single_device_mesh,
+)
+from .sharding import (
+    BATCH_SPEC,
+    TRANSFORMER_RULES,
+    batch_sharding,
+    replicated,
+    shard_batch,
+    shard_tree,
+    spec_for_path,
+    tree_shardings,
+)
+from .collectives import (
+    all_gather,
+    all_to_all,
+    pbroadcast,
+    pmax,
+    pmean,
+    psum,
+    reduce_scatter,
+    ring_permute,
+    shard_map,
+)
+from .ring_attention import attention_reference, ring_attention
+from .ulysses import ulysses_attention
+
+__all__ = [
+    "AXIS_ORDER", "MeshSpec", "TpuTopology", "build_mesh", "mesh_shape",
+    "named_sharding", "single_device_mesh", "BATCH_SPEC", "TRANSFORMER_RULES",
+    "batch_sharding", "replicated", "shard_batch", "shard_tree",
+    "spec_for_path", "tree_shardings", "all_gather", "all_to_all",
+    "pbroadcast", "pmax", "pmean", "psum", "reduce_scatter", "ring_permute",
+    "shard_map", "attention_reference", "ring_attention", "ulysses_attention",
+]

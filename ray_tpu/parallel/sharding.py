@@ -1,0 +1,122 @@
+"""Parameter/activation sharding rules: regex path -> PartitionSpec.
+
+The reference has no tensor-parallel layer of its own — TP/FSDP are
+delegated to torch FSDP / DeepSpeed inside the user loop
+(reference: python/ray/train/torch/train_loop_utils.py:162 prepare_model,
+parallel_strategy="fsdp" at :188). Here sharding is a framework primitive:
+a table of (regex on the param path) -> PartitionSpec, applied to any
+pytree. ZeRO-3 falls out for free: the same rules applied to the optimizer
+state shard it identically to the params.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+PyTree = Any
+Rules = Sequence[Tuple[str, PartitionSpec]]
+
+# Sharding rule presets for transformer params as produced by
+# ray_tpu.models (paths like "layers.3.attn.wq", "embed.embedding").
+# fsdp shards the contraction-free axis; tensor shards heads/ffn.
+TRANSFORMER_RULES: Rules = (
+    (r".*embed.*embedding$", PartitionSpec(("fsdp",), "tensor")),
+    (r".*attn\.(wq|wk|wv)$", PartitionSpec(("fsdp",), "tensor")),
+    (r".*attn\.wo$", PartitionSpec("tensor", ("fsdp",))),
+    (r".*mlp\.(w_gate|w_up)$", PartitionSpec(("fsdp",), "tensor")),
+    (r".*mlp\.w_down$", PartitionSpec("tensor", ("fsdp",))),
+    (r".*(norm|scale|bias).*", PartitionSpec()),
+    (r".*lm_head$", PartitionSpec(("fsdp",), "tensor")),
+    (r".*", PartitionSpec()),
+)
+
+# Activation specs used by trainers: batch over (data, fsdp), sequence over
+# "seq" when sequence parallelism is on.
+BATCH_SPEC = PartitionSpec(("data", "fsdp"))
+BATCH_SEQ_SPEC = PartitionSpec(("data", "fsdp"), "seq")
+
+
+def path_str(path: Tuple) -> str:
+    parts: List[str] = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return ".".join(parts)
+
+
+def spec_for_path(path: str, rules: Rules) -> PartitionSpec:
+    for pattern, spec in rules:
+        if re.fullmatch(pattern, path):
+            return spec
+    return PartitionSpec()
+
+
+def _clamp_spec(spec: PartitionSpec, shape: Tuple[int, ...], mesh: Mesh) -> PartitionSpec:
+    """Drops sharded axes that do not divide the array dim (falls back to
+    replication on that dim), and trims specs longer than the array rank."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out = []
+    for dim, entry in enumerate(spec):
+        if dim >= len(shape):
+            break
+        if entry is None:
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        total = 1
+        for a in axes:
+            total *= sizes.get(a, 1)
+        out.append(entry if total > 1 and shape[dim] % total == 0 else None)
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def tree_shardings(
+    tree: PyTree, mesh: Mesh, rules: Rules = TRANSFORMER_RULES
+) -> PyTree:
+    """PartitionSpec/NamedSharding pytree matching `tree` by path rules."""
+
+    def one(path, leaf):
+        spec = spec_for_path(path_str(path), rules)
+        shape = getattr(leaf, "shape", ())
+        return NamedSharding(mesh, _clamp_spec(spec, tuple(shape), mesh))
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def shard_tree(tree: PyTree, mesh: Mesh, rules: Rules = TRANSFORMER_RULES) -> PyTree:
+    """Places every leaf with its rule-derived NamedSharding (device_put)."""
+    shardings = tree_shardings(tree, mesh, rules)
+    return jax.tree_util.tree_map(jax.device_put, tree, shardings)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh: Mesh, *, seq: bool = False) -> NamedSharding:
+    spec = BATCH_SEQ_SPEC if seq else BATCH_SPEC
+    return NamedSharding(mesh, spec)
+
+
+def shard_batch(batch: PyTree, mesh: Mesh, *, seq: bool = False) -> PyTree:
+    """Shards host arrays of a batch over (data, fsdp)[, seq]."""
+
+    def one(leaf):
+        spec = _clamp_spec(
+            BATCH_SEQ_SPEC if seq else BATCH_SPEC, tuple(leaf.shape), mesh
+        )
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(one, batch)

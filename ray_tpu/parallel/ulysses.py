@@ -1,0 +1,63 @@
+"""Ulysses-style sequence parallelism: all-to-all head<->sequence reshard.
+
+Net-new vs the reference (SURVEY.md §2h — Ray has no SP/CP). Pattern from
+DeepSpeed-Ulysses: activations arrive sequence-sharded; before attention an
+all-to-all re-shards them head-wise (each device gets ALL positions of
+seq_parallel-th of the heads), full attention runs locally per head group,
+and a second all-to-all restores sequence sharding. Two all-to-alls per
+attention vs ring's N ppermutes — better when heads >= seq ranks and ICI
+all-to-all bandwidth is plentiful (single slice).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec
+
+from .collectives import shard_map
+from .ring_attention import attention_reference, batch_seq_spec
+
+
+def _ulysses_shard(q, k, v, *, axis: str, causal: bool, scale: Optional[float]):
+    """Per-device body. q/k/v: [b, s_shard, h, d] -> out same shape."""
+
+    def seq_to_head(x):
+        # [b, s/P, h, d] -> [b, s, h/P, d]
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    def head_to_seq(x):
+        # [b, s, h/P, d] -> [b, s/P, h, d]
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    oh = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    return head_to_seq(oh)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "seq",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention with sequence-sharded inputs via head resharding.
+
+    Requires num_heads % seq_ranks == 0. Global layout
+    [batch, seq, heads, head_dim], sharded PartitionSpec(batch, "seq").
+    """
+    n = mesh.devices.shape[mesh.axis_names.index(axis)]
+    if q.shape[2] % n:
+        raise ValueError(f"num_heads={q.shape[2]} not divisible by {axis} ranks {n}")
+    spec = batch_seq_spec(mesh, axis)
+    body = functools.partial(_ulysses_shard, axis=axis, causal=causal, scale=scale)
+    fn = shard_map(body, mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

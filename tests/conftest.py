@@ -8,13 +8,27 @@ multi-chip sharding logic is exercised without TPU hardware.
 
 import os
 
-# Must run before the first `import jax` anywhere in the test session.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Must run before jax's backends initialize. Note: this image pre-imports
+# jax via sitecustomize with an "axon" TPU-tunnel platform; jax.devices()
+# always reports that TPU, so the framework reads RAY_TPU_PLATFORM (see
+# ray_tpu.parallel.mesh.default_devices) and tests pin it to the virtual
+# 8-device CPU backend.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["RAY_TPU_PLATFORM"] = "cpu"
 
 import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _cpu_default_device():
+    """Routes un-annotated jax computations to the CPU backend so tests never
+    touch (or wait on) the tunneled TPU chip."""
+    import jax
+
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    yield
 
 
 @pytest.fixture
