@@ -1,0 +1,60 @@
+"""Small MLP classifier (the fashion-MNIST / smoke-test model).
+
+Counterpart of the reference's AIR torch MNIST benchmark workload
+(reference: release/release_tests.yaml:385-412, torch_benchmark.py) used as
+the first end-to-end JaxTrainer demo (SURVEY.md §7 phase 4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: Tuple[int, ...] = (512, 256)
+    n_classes: int = 10
+    dtype: Any = jnp.float32
+
+
+def init_params(key: jax.Array, cfg: MLPConfig) -> PyTree:
+    dims = (cfg.in_dim,) + tuple(cfg.hidden) + (cfg.n_classes,)
+    layers = []
+    keys = jax.random.split(key, len(dims) - 1)
+    for i, (k, din, dout) in enumerate(zip(keys, dims[:-1], dims[1:])):
+        layers.append(
+            {
+                "w": (jax.random.normal(k, (din, dout), jnp.float32) / math.sqrt(din)).astype(
+                    cfg.dtype
+                ),
+                "b": jnp.zeros((dout,), cfg.dtype),
+            }
+        )
+    return {"layers": layers}
+
+
+def forward(params: PyTree, x: jax.Array) -> jax.Array:
+    layers = params["layers"]
+    for layer in layers[:-1]:
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    last = layers[-1]
+    return x @ last["w"] + last["b"]
+
+
+def loss_fn(params: PyTree, batch: dict) -> jax.Array:
+    logits = forward(params, batch["x"])
+    labels = batch["y"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+def accuracy(params: PyTree, batch: dict) -> jax.Array:
+    return jnp.mean((jnp.argmax(forward(params, batch["x"]), -1) == batch["y"]).astype(jnp.float32))

@@ -1,0 +1,262 @@
+"""Flagship decoder-only transformer (Llama-2 family), TPU-first.
+
+The reference ships no model code — its training path wraps torch models in
+DDP/FSDP (reference: python/ray/train/torch/train_loop_utils.py:162
+prepare_model) and its LLM benchmarks delegate to DeepSpeed user code
+(reference: release/air_examples/gptj_deepspeed_finetuning/). The TPU-native
+framework instead provides first-class model implementations, because model
+structure and sharding layout must be co-designed for the MXU/ICI:
+
+- layers are STACKED and iterated with `lax.scan` -> compile time is O(1)
+  in depth (one layer traced once), and stacked params shard with a single
+  right-aligned rule (see ray_tpu.parallel.sharding);
+- all matmuls run in bfloat16 with fp32 accumulation
+  (`preferred_element_type`) to hit the MXU at full rate;
+- attention is pluggable: "full" (single device / tensor-parallel),
+  "ring" (ICI ring over the "seq" axis) or "ulysses" (all-to-all head
+  resharding) for long-context;
+- `jax.checkpoint` (remat) trades FLOPs for HBM when activations dominate.
+
+Pure functional: params are a plain pytree; there is no module system to
+fight the jit tracer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from ..parallel.ring_attention import attention_reference, ring_attention
+from ..parallel.ulysses import ulysses_attention
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "full"  # "full" | "ring" | "ulysses"
+    remat: bool = True
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def replace(self, **kw) -> "TransformerConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def llama2_7b(**overrides) -> TransformerConfig:
+    return TransformerConfig().replace(**overrides)
+
+
+def llama2_13b(**overrides) -> TransformerConfig:
+    return TransformerConfig(
+        d_model=5120, n_layers=40, n_heads=40, n_kv_heads=40, d_ff=13824
+    ).replace(**overrides)
+
+
+def gpt_j_6b(**overrides) -> TransformerConfig:
+    """GPT-J-6B-shaped config (the reference's DeepSpeed finetune workload,
+    reference: release/air_examples/gptj_deepspeed_finetuning/)."""
+    return TransformerConfig(
+        vocab_size=50400, d_model=4096, n_layers=28, n_heads=16, n_kv_heads=16,
+        d_ff=16384, rope_theta=10000.0,
+    ).replace(**overrides)
+
+
+def tiny(**overrides) -> TransformerConfig:
+    """CI-sized config (runs on the 8-device CPU mesh in seconds)."""
+    return TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, remat=False,
+    ).replace(**overrides)
+
+
+# ------------------------------------------------------------------ params
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> PyTree:
+    """Stacked-layer param pytree; paths match
+    ray_tpu.parallel.sharding.TRANSFORMER_RULES (right-aligned for the
+    leading n_layers dim)."""
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    L, d, f, v = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.vocab_size
+    k = iter(jax.random.split(key, 16))
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(
+            cfg.dtype
+        )
+
+    params = {
+        "embed": {"embedding": dense(next(k), (v, d), d)},
+        "blocks": {
+            "attn_norm": {"scale": jnp.ones((L, d), cfg.dtype)},
+            "attn": {
+                "wq": dense(next(k), (L, d, nh * hd), d),
+                "wk": dense(next(k), (L, d, nkv * hd), d),
+                "wv": dense(next(k), (L, d, nkv * hd), d),
+                "wo": dense(next(k), (L, nh * hd, d), nh * hd),
+            },
+            "mlp_norm": {"scale": jnp.ones((L, d), cfg.dtype)},
+            "mlp": {
+                "w_gate": dense(next(k), (L, d, f), d),
+                "w_up": dense(next(k), (L, d, f), d),
+                "w_down": dense(next(k), (L, f, d), f),
+            },
+        },
+        "final_norm": {"scale": jnp.ones((d,), cfg.dtype)},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(next(k), (d, v), d)
+    return params
+
+
+def param_count(params: PyTree) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+# ------------------------------------------------------------------ layers
+
+
+def rms_norm(x, scale, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * lax.rsqrt(var + eps)).astype(x.dtype) * scale
+
+
+def rope_tables(cfg: TransformerConfig, seq_len: int):
+    half = cfg.head_dim // 2
+    freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(seq_len, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)  # [seq, head_dim/2]
+
+
+def apply_rope(x, cos, sin):
+    """x: [b, s, h, d]; rotate-half formulation."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c, s = cos[None, :, None, :], sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
+    if cfg.n_kv_heads != cfg.n_heads:
+        rep = cfg.n_heads // cfg.n_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if cfg.attn_impl == "ring":
+        if mesh is None:
+            raise ValueError("attn_impl='ring' requires a mesh")
+        return ring_attention(q, k, v, mesh, causal=True)
+    if cfg.attn_impl == "ulysses":
+        if mesh is None:
+            raise ValueError("attn_impl='ulysses' requires a mesh")
+        return ulysses_attention(q, k, v, mesh, causal=True)
+    return attention_reference(q, k, v, causal=True)
+
+
+def _layer(x, layer_params, cfg: TransformerConfig, cos, sin, mesh: Optional[Mesh]):
+    b, s, d = x.shape
+    hd = cfg.head_dim
+    ap, mp = layer_params["attn"], layer_params["mlp"]
+
+    h = rms_norm(x, layer_params["attn_norm"]["scale"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dk->bsk", h, ap["wq"], preferred_element_type=jnp.float32)
+    k = jnp.einsum("bsd,dk->bsk", h, ap["wk"], preferred_element_type=jnp.float32)
+    v = jnp.einsum("bsd,dk->bsk", h, ap["wv"], preferred_element_type=jnp.float32)
+    q = q.reshape(b, s, cfg.n_heads, hd).astype(cfg.dtype)
+    k = k.reshape(b, s, cfg.n_kv_heads, hd).astype(cfg.dtype)
+    v = v.reshape(b, s, cfg.n_kv_heads, hd).astype(cfg.dtype)
+    q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+    o = _attention(q, k, v, cfg, mesh)
+    o = o.reshape(b, s, cfg.n_heads * hd)
+    x = x + jnp.einsum("bsk,kd->bsd", o, ap["wo"], preferred_element_type=jnp.float32).astype(
+        cfg.dtype
+    )
+
+    h = rms_norm(x, layer_params["mlp_norm"]["scale"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,df->bsf", h, mp["w_gate"], preferred_element_type=jnp.float32)
+    up = jnp.einsum("bsd,df->bsf", h, mp["w_up"], preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(cfg.dtype)
+    x = x + jnp.einsum(
+        "bsf,fd->bsd", act, mp["w_down"], preferred_element_type=jnp.float32
+    ).astype(cfg.dtype)
+    return x
+
+
+def forward(
+    params: PyTree,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """tokens [batch, seq] int32 -> logits [batch, seq, vocab] float32."""
+    b, s = tokens.shape
+    cos, sin = rope_tables(cfg, s)
+    x = jnp.take(params["embed"]["embedding"], tokens, axis=0)
+
+    body = partial(_layer, cfg=cfg, cos=cos, sin=sin, mesh=mesh)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def scan_step(x, layer_params):
+        return body(x, layer_params), None
+
+    x, _ = lax.scan(scan_step, x, params["blocks"])
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"]["embedding"].T
+    return jnp.einsum("bsd,dv->bsv", x, head, preferred_element_type=jnp.float32)
+
+
+def next_token_loss(
+    params: PyTree,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+    *,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Causal LM loss: mean cross-entropy of tokens[1:] given tokens[:-1]."""
+    logits = forward(params, tokens[:, :-1], cfg, mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        m = mask[:, 1:].astype(nll.dtype)
+        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.mean(nll)
+
+
+def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
+    """Approximate training FLOPs/token (6N + attention) for MFU accounting."""
+    n_params = (
+        cfg.vocab_size * cfg.d_model
+        + cfg.n_layers
+        * (
+            2 * cfg.d_model * cfg.n_heads * cfg.head_dim
+            + 2 * cfg.d_model * cfg.n_kv_heads * cfg.head_dim
+            + 3 * cfg.d_model * cfg.d_ff
+        )
+        + (0 if cfg.tie_embeddings else cfg.d_model * cfg.vocab_size)
+    )
+    attn = 12 * cfg.n_layers * cfg.d_model * seq_len
+    return 6.0 * n_params + attn

@@ -61,12 +61,22 @@ def spec_for_path(path: str, rules: Rules) -> PartitionSpec:
     return PartitionSpec()
 
 
-def _clamp_spec(spec: PartitionSpec, shape: Tuple[int, ...], mesh: Mesh) -> PartitionSpec:
+def _clamp_spec(
+    spec: PartitionSpec, shape: Tuple[int, ...], mesh: Mesh, *, align: str = "left"
+) -> PartitionSpec:
     """Drops sharded axes that do not divide the array dim (falls back to
-    replication on that dim), and trims specs longer than the array rank."""
+    replication on that dim), and trims specs longer than the array rank.
+
+    align="right" pads short specs with leading Nones: a rank-2 rule like
+    (fsdp, tensor) then applies to the trailing dims of stacked (scanned)
+    layer params [n_layers, in, out], replicating the layer dim. Batch specs
+    stay left-aligned (batch is always dim 0)."""
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    entries = list(spec)
+    if align == "right" and len(entries) < len(shape) and len(entries) > 0:
+        entries = [None] * (len(shape) - len(entries)) + entries
     out = []
-    for dim, entry in enumerate(spec):
+    for dim, entry in enumerate(entries):
         if dim >= len(shape):
             break
         if entry is None:
@@ -90,7 +100,7 @@ def tree_shardings(
     def one(path, leaf):
         spec = spec_for_path(path_str(path), rules)
         shape = getattr(leaf, "shape", ())
-        return NamedSharding(mesh, _clamp_spec(spec, tuple(shape), mesh))
+        return NamedSharding(mesh, _clamp_spec(spec, tuple(shape), mesh, align="right"))
 
     return jax.tree_util.tree_map_with_path(one, tree)
 
