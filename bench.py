@@ -1,0 +1,112 @@
+"""Headline benchmark: flagship-model training-step MFU on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+The reference publishes no LLM throughput numbers (BASELINE.md); the
+north-star target is >=35% MFU for Llama-family fine-tuning (BASELINE.json),
+so vs_baseline is measured MFU / 0.35. The workload is a full training step
+(forward, backward, adamw update) on a ~350M-param Llama-style model in
+bfloat16 with remat, batch sized to fill a single v5e chip.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+
+# Peak bf16 FLOP/s per chip by generation (public spec sheets).
+PEAK_FLOPS = {
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+    "cpu": 1e11,  # nominal, so the script runs anywhere
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower().replace(" ", "")
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    if "v5lite" in kind or "v5_lite" in kind or "lite" in kind:
+        return PEAK_FLOPS["v5e"]
+    return PEAK_FLOPS["cpu"]
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import transformer as tfm
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        cfg = tfm.TransformerConfig(
+            vocab_size=32768,
+            d_model=1024,
+            n_layers=16,
+            n_heads=16,
+            n_kv_heads=16,
+            d_ff=4096,
+            max_seq_len=2048,
+            dtype=jnp.bfloat16,
+            remat=True,
+        )
+        batch, seq, steps, warmup = 8, 2048, 10, 2
+    else:  # smoke-test shape for CPU runs
+        cfg = tfm.tiny(dtype=jnp.float32)
+        batch, seq, steps, warmup = 2, 64, 3, 1
+
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tx = optax.adamw(1e-4)
+    opt_state = jax.jit(tx.init)(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
+
+    # Donation: params/opt_state buffers are reused in place, halving HBM
+    # traffic and footprint for the update.
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(tfm.next_token_loss)(params, tokens, cfg)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    for _ in range(warmup):
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+    float(loss)  # device->host fetch: hard sync even through remote relays
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+    final_loss = float(loss)  # sync point ending the timed region
+    dt = time.perf_counter() - t0
+
+    tokens_per_s = batch * seq * steps / dt
+    mfu = tokens_per_s * tfm.flops_per_token(cfg, seq) / _peak_flops(dev)
+    print(
+        json.dumps(
+            {
+                "metric": "llama350m_train_mfu_1chip",
+                "value": round(mfu, 4),
+                "unit": "mfu_fraction",
+                "vs_baseline": round(mfu / 0.35, 4),
+                "tokens_per_s": round(tokens_per_s, 1),
+                "step_ms": round(1000 * dt / steps, 2),
+                "device": str(getattr(dev, "device_kind", dev.platform)),
+                "loss": final_loss,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

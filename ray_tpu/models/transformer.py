@@ -235,15 +235,21 @@ def next_token_loss(
     *,
     mask: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Causal LM loss: mean cross-entropy of tokens[1:] given tokens[:-1]."""
-    logits = forward(params, tokens[:, :-1], cfg, mesh)
-    targets = tokens[:, 1:]
+    """Causal LM loss: mean cross-entropy of token t+1 given tokens <= t.
+
+    Runs the forward at full sequence length and masks the final position
+    (rather than slicing to seq-1) so the sequence dim stays divisible by
+    the "seq" mesh axis under sequence parallelism."""
+    logits = forward(params, tokens, cfg, mesh)
+    targets = jnp.roll(tokens, -1, axis=1)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    s = tokens.shape[1]
+    valid = jnp.arange(s)[None, :] < s - 1  # last position has no target
+    m = jnp.broadcast_to(valid, nll.shape).astype(nll.dtype)
     if mask is not None:
-        m = mask[:, 1:].astype(nll.dtype)
-        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
-    return jnp.mean(nll)
+        m = m * jnp.roll(mask, -1, axis=1).astype(nll.dtype)
+    return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
 
 
 def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:

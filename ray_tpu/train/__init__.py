@@ -1,0 +1,28 @@
+"""ray_tpu.train: distributed SPMD training orchestration.
+
+TPU-native re-design of the reference's Ray Train (SURVEY.md §2d, §3.3):
+JaxTrainer replaces TorchTrainer; mesh construction replaces NCCL process
+groups; in-program psum replaces DDP allreduce.
+"""
+
+from .checkpoint import Checkpoint, CheckpointManager, StorageContext, load_pytree, save_pytree
+from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .session import get_checkpoint, get_context, get_session, report
+from .trainer import JaxTrainer, Result
+from .worker_group import WorkerGroup
+
+
+def get_mesh():
+    """The jax.sharding.Mesh this worker participates in (set up by the
+    trainer's backend phase; the analogue of fetching the torch process
+    group, reference: train/torch/config.py)."""
+    s = get_session()
+    return getattr(s, "mesh", None) if s else None
+
+
+__all__ = [
+    "Checkpoint", "CheckpointManager", "StorageContext", "load_pytree",
+    "save_pytree", "CheckpointConfig", "FailureConfig", "RunConfig",
+    "ScalingConfig", "get_checkpoint", "get_context", "get_session",
+    "report", "JaxTrainer", "Result", "WorkerGroup", "get_mesh",
+]

@@ -1,0 +1,67 @@
+"""Train/AIR config dataclasses.
+
+Mirrors the reference's ScalingConfig/RunConfig/FailureConfig/
+CheckpointConfig surface (reference: python/ray/air/config.py) with
+TPU-native additions: ScalingConfig speaks topology (`MeshSpec`,
+`topology`) instead of `use_gpu`, and placement is slice-gang-aware.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+from ..parallel.mesh import MeshSpec
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers (= hosts for multi-host TPU) and what mesh each
+    training job uses (reference: python/ray/air/config.py ScalingConfig,
+    plus the TPU pod-slice semantics of
+    python/ray/_private/accelerators/tpu.py:334-397)."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    topology: Optional[str] = None  # e.g. "v5e-8"; None = all local devices
+    mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    @property
+    def total_workers(self) -> int:
+        return max(1, self.num_workers)
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """(reference: python/ray/air/config.py FailureConfig)"""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Keep-K + score-attribute retention
+    (reference: python/ray/air/config.py CheckpointConfig,
+    python/ray/train/_internal/checkpoint_manager.py)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """(reference: python/ray/air/config.py RunConfig)"""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        return self.storage_path or os.path.expanduser("~/ray_tpu_results")

@@ -1,0 +1,147 @@
+"""Worker-side training session: report(), checkpoints, rank info.
+
+Mirrors the reference's _TrainSession (reference:
+python/ray/train/_internal/session.py:111; report at :403/:667 puts a
+result on a size-1 queue consumed by the coordinator's TrainingIterator,
+train/trainer.py:124). Same backpressure design here: `report` blocks until
+the coordinator consumes the previous result, keeping worker and driver in
+lockstep and bounding memory.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from .checkpoint import Checkpoint
+
+_session_lock = threading.Lock()
+_session: Optional["TrainSession"] = None
+# Thread-keyed registry: in the thread-based local runtime all worker
+# "actors" share one process, so each training thread must resolve to ITS
+# session, not a process global (cross-wiring num_workers>1 otherwise).
+_thread_sessions: dict = {}
+
+
+class TrainSession:
+    def __init__(
+        self,
+        world_rank: int,
+        world_size: int,
+        local_rank: int = 0,
+        trial_name: str = "",
+        checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.trial_name = trial_name
+        self._starting_checkpoint = checkpoint
+        # maxsize=1: report() blocks until the previous result is consumed
+        # (reference: session.py:204).
+        self._result_queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self._finished = threading.Event()
+
+    # ------------------------------------------------------------ user API
+    def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+        self._result_queue.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self._starting_checkpoint
+
+    # ------------------------------------------------------ coordinator API
+    def next_result(self, timeout: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """Returns the next reported result, or None once training finished
+        and the queue is drained."""
+        while True:
+            try:
+                return self._result_queue.get(timeout=0.1)
+            except queue.Empty:
+                if self._finished.is_set():
+                    try:
+                        return self._result_queue.get_nowait()
+                    except queue.Empty:
+                        return None
+                if timeout is not None:
+                    timeout -= 0.1
+                    if timeout <= 0:
+                        raise TimeoutError("no training result within timeout")
+
+    def mark_finished(self):
+        self._finished.set()
+
+    # --------------------------------------------------- thread attachment
+    def attach_to_current_thread(self) -> None:
+        """Binds this session to the calling (training) thread so
+        `train.report()` inside user code resolves to it even when several
+        worker actors share the process."""
+        with _session_lock:
+            _thread_sessions[threading.get_ident()] = self
+
+    def detach_from_current_thread(self) -> None:
+        with _session_lock:
+            _thread_sessions.pop(threading.get_ident(), None)
+
+
+def init_session(**kwargs) -> TrainSession:
+    global _session
+    with _session_lock:
+        _session = TrainSession(**kwargs)
+        return _session
+
+
+def get_session() -> Optional[TrainSession]:
+    with _session_lock:
+        s = _thread_sessions.get(threading.get_ident())
+    return s if s is not None else _session
+
+
+def shutdown_session(session: Optional[TrainSession] = None):
+    global _session
+    with _session_lock:
+        if session is None or _session is session:
+            _session = None
+        if session is not None:
+            stale = [k for k, v in _thread_sessions.items() if v is session]
+            for k in stale:
+                _thread_sessions.pop(k, None)
+
+
+# ----------------------------------------------------------- user functions
+# (the `ray.train.report` / `get_context` equivalents, reference:
+# python/ray/train/_internal/session.py module-level helpers)
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None) -> None:
+    s = get_session()
+    if s is None:
+        raise RuntimeError("train.report() called outside a training session")
+    s.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    s = get_session()
+    return s.get_checkpoint() if s else None
+
+
+class TrainContext:
+    def get_world_rank(self) -> int:
+        s = get_session()
+        return s.world_rank if s else 0
+
+    def get_world_size(self) -> int:
+        s = get_session()
+        return s.world_size if s else 1
+
+    def get_local_rank(self) -> int:
+        s = get_session()
+        return s.local_rank if s else 0
+
+    def get_trial_name(self) -> str:
+        s = get_session()
+        return s.trial_name if s else ""
+
+
+def get_context() -> TrainContext:
+    return TrainContext()

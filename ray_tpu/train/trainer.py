@@ -1,0 +1,194 @@
+"""JaxTrainer: the DataParallelTrainer equivalent, TPU-native.
+
+Reference call stack being re-designed (SURVEY.md §3.3):
+BaseTrainer.fit (python/ray/train/base_trainer.py:567) ->
+DataParallelTrainer.training_loop (data_parallel_trainer.py:428) ->
+BackendExecutor.start (train/_internal/backend_executor.py:135) ->
+WorkerGroup actors + NCCL process group (torch/config.py:66).
+
+TPU-native shape: the trainer creates a gang of worker actors (one per
+host), each worker builds its shard of a `jax.sharding.Mesh` from the
+ScalingConfig's MeshSpec, and the user's `train_loop_per_worker` runs the
+same jitted SPMD program on every host — collectives compile into the
+program over ICI; there is no out-of-band process group to bootstrap.
+Results flow back through the size-1 session queue exactly as in the
+reference (TrainingIterator, train/trainer.py:124).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import api
+from ..core.placement_group import placement_group as create_pg
+from .checkpoint import Checkpoint, CheckpointManager, StorageContext
+from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .worker_group import WorkerGroup
+
+
+@dataclasses.dataclass
+class Result:
+    """(reference: python/ray/air/result.py Result)"""
+
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    metrics_dataframe: Optional[Any] = None
+    error: Optional[BaseException] = None
+
+    @property
+    def best_checkpoints(self) -> List[Checkpoint]:
+        return [self.checkpoint] if self.checkpoint else []
+
+
+class JaxTrainer:
+    """Distributed SPMD training over a worker gang.
+
+    Usage (mirrors the reference's TorchTrainer surface so call sites port
+    mechanically):
+
+        def train_loop(config):
+            mesh = train.get_mesh()
+            ... jitted step over the mesh ...
+            train.report({"loss": ...}, checkpoint=...)
+
+        trainer = JaxTrainer(
+            train_loop,
+            train_loop_config={"lr": 1e-3},
+            scaling_config=ScalingConfig(num_workers=1, mesh=MeshSpec(data=-1)),
+            run_config=RunConfig(name="exp"),
+        )
+        result = trainer.fit()
+    """
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self._train_loop = train_loop_per_worker
+        self._config = dict(train_loop_config or {})
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self._datasets = dict(datasets or {})
+        self._resume_from = resume_from_checkpoint
+
+    # ------------------------------------------------------------------ fit
+    def fit(self) -> Result:
+        name = self.run_config.name or f"JaxTrainer_{uuid.uuid4().hex[:8]}"
+        storage = StorageContext(self.run_config.resolved_storage_path(), name)
+        ckpt_cfg: CheckpointConfig = self.run_config.checkpoint_config
+        manager = CheckpointManager(
+            num_to_keep=ckpt_cfg.num_to_keep,
+            score_attribute=ckpt_cfg.checkpoint_score_attribute,
+            score_order=ckpt_cfg.checkpoint_score_order,
+        )
+        max_failures = self.run_config.failure_config.max_failures
+        attempt = 0
+        resume_ckpt = self._resume_from
+        last_error: Optional[BaseException] = None
+        metrics: Dict[str, Any] = {}
+
+        while True:
+            try:
+                metrics = self._run_attempt(storage, manager, resume_ckpt)
+                last_error = None
+                break
+            except (KeyboardInterrupt, SystemExit):
+                raise  # user abort is not a training failure
+            except Exception as e:  # noqa: BLE001
+                last_error = e
+                metrics = getattr(self, "_last_metrics", {})
+                attempt += 1
+                # Elastic restart from the latest checkpoint (reference:
+                # FailureConfig via Tune, base_trainer.py:577 resume path).
+                resume_ckpt = manager.latest_checkpoint or resume_ckpt
+                if max_failures >= 0 and attempt > max_failures:
+                    break
+
+        storage.write_json(
+            "result.json",
+            {"metrics": metrics, "error": repr(last_error) if last_error else None},
+        )
+        return Result(
+            metrics=metrics,
+            checkpoint=manager.best_checkpoint or manager.latest_checkpoint,
+            path=storage.trial_dir,
+            error=last_error,
+        )
+
+    # ---------------------------------------------------------------- inner
+    def _run_attempt(
+        self,
+        storage: StorageContext,
+        manager: CheckpointManager,
+        resume_ckpt: Optional[Checkpoint],
+    ) -> Dict[str, Any]:
+        import cloudpickle
+
+        sc = self.scaling_config
+        pg = None
+        if sc.num_workers > 1:
+            bundles = [dict(sc.resources_per_worker or {"CPU": 1}) for _ in range(sc.num_workers)]
+            pg = create_pg(bundles, strategy=sc.placement_strategy)
+
+        group = WorkerGroup(
+            sc.num_workers,
+            resources_per_worker=sc.resources_per_worker,
+            placement_group=pg,
+        )
+        self._last_metrics: Dict[str, Any] = {}
+        try:
+            # Backend setup: every worker builds its mesh (the analogue of
+            # _setup_torch_process_group, reference: torch/config.py:66).
+            from ..parallel.mesh import default_devices
+
+            mesh_axes = sc.mesh.resolve(len(default_devices()))
+            api.get([w.setup_mesh.remote(mesh_axes) for w in group.workers])
+
+            blob = cloudpickle.dumps(self._train_loop)
+            config = dict(self._config)
+            if self._datasets:
+                config["__datasets__"] = self._datasets
+            api.get(
+                [
+                    w.start_training.remote(
+                        blob,
+                        config,
+                        storage.trial_name or storage.experiment_name,
+                        resume_ckpt.path if resume_ckpt else None,
+                    )
+                    for w in group.workers
+                ]
+            )
+
+            ckpt_index = 0
+            while True:
+                results = api.get([w.next_result.remote() for w in group.workers])
+                if all(r is None for r in results):
+                    break
+                live = [r for r in results if r is not None]
+                rank0 = results[0] if results[0] is not None else live[0]
+                self._last_metrics = dict(rank0["metrics"])
+                ckpt_path = rank0.get("checkpoint")
+                if ckpt_path:
+                    persisted = storage.persist_checkpoint(Checkpoint(ckpt_path), ckpt_index)
+                    manager.register(persisted, self._last_metrics)
+                    ckpt_index += 1
+
+            api.get([w.join.remote() for w in group.workers])
+            return self._last_metrics
+        finally:
+            group.shutdown()
+            if pg is not None:
+                from ..core.placement_group import remove_placement_group
+
+                remove_placement_group(pg)

@@ -1,0 +1,176 @@
+"""WorkerGroup: N training-worker actors, gang-placed.
+
+Mirrors the reference's WorkerGroup (reference:
+python/ray/train/_internal/worker_group.py:102, execute at :260): a generic
+"run this function on every worker" pool of actors. TPU-native difference:
+one worker == one HOST of a pod slice (SPMD: every host runs the same
+program over the shared mesh), so the group also owns the rank table handed
+to `jax.distributed.initialize`-style setup.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import api
+from ..core.placement_group import PlacementGroupSchedulingStrategy
+from .session import TrainSession, get_session, init_session, shutdown_session
+
+
+class _TrainWorker:
+    """Actor body hosting one training worker (one host's SPMD process)."""
+
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._mesh = None
+        self._session = None
+
+    # generic execute (reference: worker_group.py execute)
+    def execute(self, fn_blob: bytes, *args, **kwargs):
+        import cloudpickle
+
+        fn = cloudpickle.loads(fn_blob)
+        return fn(*args, **kwargs)
+
+    def setup_mesh(self, mesh_axes: Dict[str, int]):
+        """Backend hook: build the device mesh this worker participates in."""
+        from ..parallel.mesh import build_mesh
+
+        self._mesh = build_mesh(axis_sizes=mesh_axes) if mesh_axes else build_mesh()
+        return {"devices": int(self._mesh.devices.size)}
+
+    def start_training(
+        self,
+        fn_blob: bytes,
+        config: Dict[str, Any],
+        trial_name: str,
+        checkpoint_path: Optional[str],
+    ):
+        import cloudpickle
+
+        from .checkpoint import Checkpoint
+
+        fn = cloudpickle.loads(fn_blob)
+        ckpt = Checkpoint(checkpoint_path) if checkpoint_path else None
+        session = init_session(
+            world_rank=self.rank,
+            world_size=self.world_size,
+            trial_name=trial_name,
+            checkpoint=ckpt,
+        )
+        session.mesh = self._mesh
+        self._session = session
+
+        def run():
+            session.attach_to_current_thread()
+            try:
+                if _takes_config(fn):
+                    fn(config)
+                else:
+                    fn()
+            except BaseException as e:  # noqa: BLE001
+                self._error = e
+            finally:
+                session.detach_from_current_thread()
+                session.mark_finished()
+
+        self._thread = threading.Thread(target=run, name=f"train-rank{self.rank}", daemon=True)
+        self._thread.start()
+        return True
+
+    def next_result(self):
+        session = self._session
+        if session is None:
+            return None
+        out = session.next_result()
+        if out is None and self._error is not None:
+            raise self._error
+        if out is not None and out.get("checkpoint") is not None:
+            out = dict(out)
+            out["checkpoint"] = out["checkpoint"].path
+        return out
+
+    def join(self):
+        if self._thread is not None:
+            self._thread.join()
+        shutdown_session(self._session)
+        if self._error is not None:
+            raise self._error
+        return True
+
+    def ping(self):
+        return self.rank
+
+
+def _takes_config(fn) -> bool:
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return True
+    return len(sig.parameters) >= 1
+
+
+class WorkerGroup:
+    """Driver-side handle to the gang of training workers."""
+
+    def __init__(
+        self,
+        num_workers: int,
+        resources_per_worker: Optional[Dict[str, float]] = None,
+        placement_group=None,
+    ):
+        self.num_workers = num_workers
+        opts: Dict[str, Any] = {"max_concurrency": 4}
+        res = dict(resources_per_worker or {})
+        if "CPU" in res:
+            opts["num_cpus"] = res.pop("CPU")
+        if "TPU" in res:
+            opts["num_tpus"] = res.pop("TPU")
+        if res:
+            opts["resources"] = res
+        worker_cls = api.remote(**opts)(_TrainWorker)
+        self._workers = []
+        for rank in range(num_workers):
+            w_opts = {}
+            if placement_group is not None:
+                w_opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                    placement_group=placement_group, placement_group_bundle_index=rank
+                )
+            self._workers.append(
+                worker_cls.options(**w_opts).remote(rank, num_workers) if w_opts
+                else worker_cls.remote(rank, num_workers)
+            )
+        # Barrier on construction.
+        api.get([w.ping.remote() for w in self._workers])
+
+    @property
+    def workers(self) -> List[Any]:
+        return list(self._workers)
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        """Runs fn on every worker, returns all results
+        (reference: worker_group.py:260)."""
+        from ..core.task_spec import FunctionTable
+
+        blob, _ = FunctionTable.dumps(fn)
+        return api.get([w.execute.remote(blob, *args, **kwargs) for w in self._workers])
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        from ..core.task_spec import FunctionTable
+
+        blob, _ = FunctionTable.dumps(fn)
+        return api.get(self._workers[rank].execute.remote(blob, *args, **kwargs))
+
+    def shutdown(self):
+        for w in self._workers:
+            try:
+                api.kill(w)
+            except Exception:
+                pass
+        self._workers = []

@@ -1,0 +1,208 @@
+"""JaxTrainer end-to-end on the local runtime + virtual CPU mesh
+(SURVEY.md §7 phase 4: the minimum end-to-end model slice)."""
+
+import os
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rt(tmp_path):
+    import ray_tpu as rtpu
+
+    rtpu.shutdown()
+    rtpu.init(local_mode=True, num_cpus=8)
+    yield rtpu
+    rtpu.shutdown()
+
+
+def test_checkpoint_manager_keep_k(tmp_path):
+    from ray_tpu.train import Checkpoint, CheckpointManager
+
+    mgr = CheckpointManager(num_to_keep=2, score_attribute="acc", score_order="max")
+    paths = []
+    for i, acc in enumerate([0.1, 0.9, 0.5, 0.2]):
+        d = tmp_path / f"ck{i}"
+        d.mkdir()
+        (d / "x").write_text(str(i))
+        mgr.register(Checkpoint(str(d)), {"acc": acc})
+        paths.append(str(d))
+    kept = {c.path for c in mgr.checkpoints}
+    assert len(kept) == 2
+    assert str(tmp_path / "ck1") in kept  # best acc=0.9 kept
+    assert not os.path.exists(paths[0])  # worst evicted from disk
+    assert mgr.best_checkpoint.path == str(tmp_path / "ck1")
+
+
+def test_save_load_pytree(tmp_path):
+    import jax.numpy as jnp
+
+    from ray_tpu.train import load_pytree, save_pytree
+
+    tree = {"a": jnp.arange(6).reshape(2, 3), "b": {"c": jnp.ones((4,))}}
+    save_pytree(tree, str(tmp_path / "ck"))
+    out = load_pytree(str(tmp_path / "ck"))
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(6).reshape(2, 3))
+    np.testing.assert_array_equal(np.asarray(out["b"]["c"]), np.ones((4,)))
+
+
+def test_worker_group_execute(rt):
+    from ray_tpu.train import WorkerGroup
+
+    group = WorkerGroup(num_workers=2)
+    ranks = group.execute(lambda: __import__("threading").current_thread().name)
+    assert len(ranks) == 2
+    group.shutdown()
+
+
+def test_jax_trainer_mlp_end_to_end(rt, tmp_path):
+    """The BASELINE config-#1 demo: MLP under pjit DP on the CPU mesh, with
+    session.report + checkpointing + result plumbing."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu import train as rt_train
+    from ray_tpu.parallel import MeshSpec
+    from ray_tpu.train import (
+        Checkpoint,
+        CheckpointConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+        save_pytree,
+    )
+
+    def train_loop(config):
+        import tempfile
+
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu import train as rt_train
+        from ray_tpu.models import mlp
+        from ray_tpu.parallel import shard_batch, shard_tree
+        from ray_tpu.parallel.sharding import Rules
+
+        mesh = rt_train.get_mesh()
+        assert mesh is not None, "backend must provide the mesh"
+        cfg = mlp.MLPConfig(in_dim=8, hidden=(32,), n_classes=4)
+        params = mlp.init_params(jax.random.PRNGKey(0), cfg)
+        params = shard_tree(params, mesh, rules=((r".*", jax.sharding.PartitionSpec()),))
+
+        key = jax.random.PRNGKey(1)
+        x = jax.random.normal(key, (64, 8))
+        y = (jnp.sum(x, axis=-1) > 0).astype(jnp.int32) % 4
+        batch = shard_batch({"x": x, "y": y}, mesh)
+
+        @jax.jit
+        def step(p, b):
+            l, g = jax.value_and_grad(mlp.loss_fn)(p, b)
+            return l, jax.tree_util.tree_map(lambda w, gw: w - config["lr"] * gw, p, g)
+
+        p = params
+        for epoch in range(config["epochs"]):
+            loss, p = step(p, batch)
+            ckpt = None
+            if epoch == config["epochs"] - 1:
+                d = tempfile.mkdtemp(prefix="mlp-ck-")
+                save_pytree(jax.device_get(p), d)
+                ckpt = rt_train.Checkpoint(d)
+            rt_train.report({"loss": float(loss), "epoch": epoch}, checkpoint=ckpt)
+
+    trainer = JaxTrainer(
+        train_loop,
+        train_loop_config={"lr": 0.5, "epochs": 3},
+        scaling_config=ScalingConfig(num_workers=1, mesh=MeshSpec(data=-1)),
+        run_config=RunConfig(
+            name="mlp_e2e",
+            storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(num_to_keep=1),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["epoch"] == 2
+    assert np.isfinite(result.metrics["loss"])
+    assert result.checkpoint is not None
+    # checkpoint persisted into the trial dir and loadable
+    from ray_tpu.train import load_pytree
+
+    tree = load_pytree(result.checkpoint.path)
+    assert "layers" in tree
+
+
+def test_trainer_failure_then_resume(rt, tmp_path):
+    """max_failures: worker fails once, restarts from latest checkpoint."""
+    import tempfile
+
+    from ray_tpu import train as rt_train
+    from ray_tpu.parallel import MeshSpec
+    from ray_tpu.train import (
+        CheckpointConfig,
+        FailureConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+        save_pytree,
+    )
+
+    def train_loop(config):
+        import os
+        import tempfile
+
+        from ray_tpu import train as rt_train
+
+        start = 0
+        ck = rt_train.get_checkpoint()
+        if ck is not None:
+            from ray_tpu.train import load_pytree
+
+            start = int(load_pytree(ck.path)["step"]) + 1
+        for step in range(start, 4):
+            d = tempfile.mkdtemp(prefix="fail-ck-")
+            save_pytree({"step": step}, d)
+            rt_train.report({"step": step}, checkpoint=rt_train.Checkpoint(d))
+            if step == 1 and ck is None:
+                raise RuntimeError("injected failure")
+
+    trainer = JaxTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(num_workers=1, mesh=MeshSpec(data=-1)),
+        run_config=RunConfig(
+            name="resume_e2e",
+            storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+            checkpoint_config=CheckpointConfig(num_to_keep=None),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3
+
+
+def test_multi_worker_sessions_not_crosswired(rt, tmp_path):
+    """num_workers=2 in the thread-based runtime: each worker's report()
+    stream must stay on its own session (regression: module-global session
+    cross-wired workers)."""
+    from ray_tpu.parallel import MeshSpec
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def train_loop(config):
+        from ray_tpu import train as rt_train
+
+        ctx = rt_train.get_context()
+        assert ctx.get_world_size() == 2
+        for step in range(3):
+            rt_train.report({"rank": ctx.get_world_rank(), "step": step})
+
+    trainer = JaxTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(num_workers=2, mesh=MeshSpec(data=-1)),
+        run_config=RunConfig(name="two_workers", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    # rank-0's metrics surface in the result, and its stream stayed rank 0
+    assert result.metrics["rank"] == 0
+    assert result.metrics["step"] == 2
