@@ -1,0 +1,21 @@
+"""ray_tpu.data: lazy distributed datasets with streaming execution
+(re-design of the reference's Ray Data, SURVEY.md §2c)."""
+
+from .block import Block, BlockAccessor
+from .dataset import (
+    Dataset,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_csv,
+    read_json,
+    read_parquet,
+)
+from .iterator import DataIterator
+
+__all__ = [
+    "Block", "BlockAccessor", "Dataset", "DataIterator", "from_items",
+    "from_numpy", "from_pandas", "range", "read_csv", "read_json",
+    "read_parquet",
+]

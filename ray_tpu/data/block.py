@@ -1,0 +1,174 @@
+"""Block layer: the unit of distributed data.
+
+Condensed re-design of the reference's block layer (reference:
+python/ray/data/block.py BlockAccessor, _internal/arrow_block.py,
+_internal/pandas_block.py). A block is either a pyarrow Table (tabular) or
+a list of rows; BlockAccessor normalizes both. Batches surface as dicts of
+numpy arrays — the zero-copy format `device_put` consumes, which is the
+whole point of the data plane on TPU hosts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Union
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+except ImportError:  # pragma: no cover
+    pa = None
+
+Block = Union["pa.Table", List[Any]]
+Batch = Union[Dict[str, np.ndarray], "pa.Table", "list"]
+
+
+def _column_to_numpy(col) -> np.ndarray:
+    """Arrow column -> numpy; list columns (tensor columns) stack into a
+    dense ndarray instead of degrading to dtype=object."""
+    arr = col.combine_chunks() if hasattr(col, "combine_chunks") else col
+    t = arr.type
+    if pa.types.is_list(t) or pa.types.is_large_list(t) or pa.types.is_fixed_size_list(t):
+        return np.array(arr.to_pylist())
+    return np.asarray(arr)
+
+
+class BlockAccessor:
+    """Uniform view over a block (reference: python/ray/data/block.py:389)."""
+
+    def __init__(self, block: Block):
+        self._block = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    # ------------------------------------------------------------- basics
+    def num_rows(self) -> int:
+        if pa is not None and isinstance(self._block, pa.Table):
+            return self._block.num_rows
+        return len(self._block)
+
+    def size_bytes(self) -> int:
+        if pa is not None and isinstance(self._block, pa.Table):
+            return self._block.nbytes
+        try:
+            import sys
+
+            return sum(sys.getsizeof(r) for r in self._block)
+        except Exception:
+            return 0
+
+    def schema(self):
+        if pa is not None and isinstance(self._block, pa.Table):
+            return self._block.schema
+        if self._block:
+            first = self._block[0]
+            if isinstance(first, dict):
+                return {k: type(v).__name__ for k, v in first.items()}
+            return type(first).__name__
+        return None
+
+    # -------------------------------------------------------------- views
+    def iter_rows(self) -> Iterator[Any]:
+        if pa is not None and isinstance(self._block, pa.Table):
+            for batch in self._block.to_batches():
+                cols = {name: batch.column(i) for i, name in enumerate(batch.schema.names)}
+                for i in range(batch.num_rows):
+                    yield {k: v[i].as_py() for k, v in cols.items()}
+        else:
+            yield from self._block
+
+    def to_batch(self, batch_format: str = "numpy") -> Batch:
+        if pa is not None and isinstance(self._block, pa.Table):
+            if batch_format == "numpy":
+                return {
+                    name: _column_to_numpy(self._block.column(name))
+                    for name in self._block.schema.names
+                }
+            if batch_format == "pandas":
+                return self._block.to_pandas()
+            if batch_format == "pyarrow":
+                return self._block
+            raise ValueError(f"unknown batch_format {batch_format!r}")
+        rows = self._block
+        if batch_format not in ("numpy", "pandas", "pyarrow", "rows"):
+            raise ValueError(f"unknown batch_format {batch_format!r}")
+        if batch_format == "rows":
+            return rows
+        if rows and isinstance(rows[0], dict):
+            if batch_format == "numpy":
+                keys = rows[0].keys()
+                return {k: np.asarray([r[k] for r in rows]) for k in keys}
+            if batch_format == "pandas":
+                import pandas as pd
+
+                return pd.DataFrame(rows)
+            if batch_format == "pyarrow":
+                return pa.Table.from_pylist(rows)
+        # Simple (non-dict) rows surface as an "item" column, matching the
+        # reference's simple-dataset batch convention.
+        if batch_format == "numpy":
+            return {"item": np.asarray(rows)}
+        if batch_format == "pandas":
+            import pandas as pd
+
+            return pd.DataFrame({"item": rows})
+        return pa.table({"item": pa.array(rows)})
+
+    def slice(self, start: int, end: int) -> Block:
+        if pa is not None and isinstance(self._block, pa.Table):
+            return self._block.slice(start, end - start)
+        return self._block[start:end]
+
+
+def block_from_batch(batch: Batch) -> Block:
+    """Normalizes a user-returned batch back into a block."""
+    if pa is not None and isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, dict):
+        if pa is not None:
+            cols = {}
+            for k, v in batch.items():
+                arr = np.asarray(v)
+                if arr.ndim > 1:
+                    # tensor column: keep as list-of-lists arrow column
+                    cols[k] = pa.array(list(arr))
+                else:
+                    cols[k] = pa.array(arr)
+            return pa.table(cols)
+        raise RuntimeError("dict batches require pyarrow")
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            if pa is None:
+                raise RuntimeError("DataFrame batches require pyarrow")
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(batch, list):
+        return batch
+    raise TypeError(f"cannot convert batch of type {type(batch).__name__} to a block")
+
+
+def block_from_rows(rows: List[Any]) -> Block:
+    """Rows -> block; dict rows become arrow tables when possible."""
+    if rows and isinstance(rows[0], dict) and pa is not None:
+        try:
+            return pa.Table.from_pylist(rows)
+        except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
+            return rows
+    return rows
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    real = [b for b in blocks if BlockAccessor(b).num_rows() > 0]
+    if not real:
+        return blocks[0] if blocks else []
+    if pa is not None and all(isinstance(b, pa.Table) for b in real):
+        return pa.concat_tables(real)
+    out: List[Any] = []
+    for b in real:
+        out.extend(BlockAccessor(b).iter_rows())
+    return out

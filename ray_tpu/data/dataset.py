@@ -1,0 +1,469 @@
+"""Dataset: lazy logical plan + streaming distributed execution.
+
+Re-design of the reference's Ray Data core (reference:
+python/ray/data/dataset.py Dataset:141, map_batches:391,
+iter_batches:3844, streaming_split:1387; logical plan
+_internal/logical/*, streaming executor _internal/execution/
+streaming_executor.py:48). Key simplification, TPU-first: the unit of
+streaming is the block task — adjacent row/batch transforms FUSE into one
+task per block (the reference's zero-copy map fusion rule,
+_internal/logical/rules/operator_fusion.py), so a block is read,
+transformed and returned in a single remote call with no intermediate
+materialization. Barrier ops (repartition, shuffle, sort) materialize.
+
+Execution is pull-based and windowed: `iter_batches` keeps at most
+`prefetch` block-tasks in flight — backpressure falls out of the pull loop
+(the reference needs a dedicated resource-budget state machine,
+streaming_executor_state.py:527; here the window IS the budget).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Union
+
+import numpy as np
+
+from .. import api
+from .block import Block, BlockAccessor, block_from_batch, block_from_rows, concat_blocks
+from .datasource import (
+    CSVDatasource,
+    Datasource,
+    ItemsDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    ReadTask,
+    write_parquet_block,
+)
+
+DEFAULT_PARALLELISM = 16
+
+# This module exports a `range(n)` dataset constructor (reference:
+# read_api.py); keep the builtin reachable for internal index loops.
+_range = range
+
+
+def _ensure_initialized():
+    if not api.is_initialized():
+        api.init(local_mode=True)
+
+
+# ------------------------------------------------------------- logical plan
+
+
+@dataclass
+class _Op:
+    kind: str  # read | input | map_rows | filter | flat_map | map_batches | repartition | shuffle | sort | limit
+    fn: Optional[Callable] = None
+    datasource: Optional[Datasource] = None
+    parallelism: int = DEFAULT_PARALLELISM
+    blocks: Optional[List[Any]] = None  # materialized input refs
+    batch_size: Optional[int] = None
+    batch_format: str = "numpy"
+    n: int = 0
+    key: Optional[Any] = None
+    descending: bool = False
+    seed: Optional[int] = None
+    concurrency: Optional[int] = None  # actor-pool size for map_batches
+
+    def fusable(self) -> bool:
+        return self.kind in ("map_rows", "filter", "flat_map", "map_batches") and (
+            self.concurrency is None
+        )
+
+
+def _apply_fused(block: Block, ops: List[_Op]) -> Block:
+    """Runs a fused chain of transforms on one block inside a task."""
+    for op in ops:
+        acc = BlockAccessor(block)
+        if op.kind == "map_rows":
+            block = block_from_rows([op.fn(r) for r in acc.iter_rows()])
+        elif op.kind == "filter":
+            block = block_from_rows([r for r in acc.iter_rows() if op.fn(r)])
+        elif op.kind == "flat_map":
+            out: List[Any] = []
+            for r in acc.iter_rows():
+                out.extend(op.fn(r))
+            block = block_from_rows(out)
+        elif op.kind == "map_batches":
+            n = acc.num_rows()
+            bs = op.batch_size or n or 1
+            outs = []
+            for start in _range(0, n, bs):
+                sub = BlockAccessor(acc.slice(start, min(start + bs, n)))
+                batch = sub.to_batch(op.batch_format)
+                res = op.fn(batch)
+                outs.append(block_from_batch(res))
+            block = concat_blocks(outs) if outs else block_from_rows([])
+        else:  # pragma: no cover
+            raise ValueError(f"not fusable: {op.kind}")
+    return block
+
+
+class _BatchMapActor:
+    """Actor-pool worker for map_batches(concurrency=N) — the analogue of
+    ActorPoolMapOperator (reference: _internal/execution/operators/
+    actor_pool_map_operator.py:34); holds expensive per-process state (e.g.
+    a jitted model) across blocks."""
+
+    def __init__(self, fn_blob: bytes):
+        import cloudpickle
+
+        fn_or_cls = cloudpickle.loads(fn_blob)
+        self._fn = fn_or_cls() if isinstance(fn_or_cls, type) else fn_or_cls
+
+    def apply(self, block: Block, batch_size: Optional[int], batch_format: str) -> Block:
+        op = _Op(kind="map_batches", fn=self._fn, batch_size=batch_size, batch_format=batch_format)
+        return _apply_fused(block, [op])
+
+
+@dataclass
+class ExecStats:
+    num_blocks: int = 0
+    wall_s: float = 0.0
+
+
+def _windowed(refs: Iterator[Any], window: int) -> Iterator[Any]:
+    """Lookahead buffer: pulls (and thereby submits) up to `window` refs
+    ahead of the consumer — bounded in-flight work with read/compute overlap."""
+    from collections import deque
+
+    buf: "deque" = deque()
+    for r in refs:
+        buf.append(r)
+        if len(buf) > window:
+            yield buf.popleft()
+    while buf:
+        yield buf.popleft()
+
+
+class Dataset:
+    """Lazy, immutable distributed dataset (reference: dataset.py:141)."""
+
+    def __init__(self, ops: List[_Op]):
+        self._ops = ops
+        self.stats = ExecStats()
+
+    # ------------------------------------------------------- constructors
+    @staticmethod
+    def from_ops(ops: List[_Op]) -> "Dataset":
+        return Dataset(ops)
+
+    def _extended(self, op: _Op) -> "Dataset":
+        return Dataset(self._ops + [op])
+
+    # --------------------------------------------------------- transforms
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        return self._extended(_Op(kind="map_rows", fn=fn))
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        return self._extended(_Op(kind="filter", fn=fn))
+
+    def flat_map(self, fn: Callable[[Any], Iterable[Any]]) -> "Dataset":
+        return self._extended(_Op(kind="flat_map", fn=fn))
+
+    def map_batches(
+        self,
+        fn: Union[Callable, type],
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+        concurrency: Optional[int] = None,
+        **_ignored,
+    ) -> "Dataset":
+        """(reference: dataset.py:391)"""
+        return self._extended(
+            _Op(
+                kind="map_batches",
+                fn=fn,
+                batch_size=batch_size,
+                batch_format=batch_format,
+                concurrency=concurrency,
+            )
+        )
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._extended(_Op(kind="repartition", n=num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._extended(_Op(kind="shuffle", seed=seed))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._extended(_Op(kind="sort", key=key, descending=descending))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._extended(_Op(kind="limit", n=n))
+
+    # ---------------------------------------------------------- execution
+    def _plan_stages(self):
+        """Splits ops into (source, [stage...]) where each stage is either
+        a fused chain, an actor-pool map, or a barrier op."""
+        ops = self._ops
+        source = ops[0]
+        assert source.kind in ("read", "input")
+        stages: List[Any] = []
+        fused: List[_Op] = []
+        for op in ops[1:]:
+            if op.fusable():
+                fused.append(op)
+            else:
+                if fused:
+                    stages.append(("fused", fused))
+                    fused = []
+                stages.append((op.kind, op))
+        if fused:
+            stages.append(("fused", fused))
+        return source, stages
+
+    def _source_iter(self, source: _Op) -> Iterator[Any]:
+        """Lazily submits read tasks — pulled through the prefetch window, so
+        a huge directory is not all read up front."""
+        _ensure_initialized()
+        if source.kind == "input":
+            yield from list(source.blocks or [])
+            return
+        tasks = source.datasource.get_read_tasks(source.parallelism)
+
+        @api.remote
+        def do_read(task: ReadTask) -> Block:
+            return task()
+
+        for t in tasks:
+            yield do_read.remote(t)
+
+    def iter_block_refs(self, prefetch: int = 8) -> Iterator[Any]:
+        """The streaming executor: yields refs to output blocks, keeping at
+        most `prefetch` block-task chains in flight (the pull window IS the
+        backpressure budget). Barrier stages (repartition/shuffle/sort)
+        materialize their input before streaming resumes."""
+        import time as _time
+
+        _ensure_initialized()
+        t0 = _time.perf_counter()
+        source, stages = self._plan_stages()
+        refs: Iterator[Any] = self._source_iter(source)
+
+        for kind, payload in stages:
+            if kind == "fused":
+                refs = self._launch_fused(refs, payload)
+            elif kind == "map_batches":
+                refs = self._launch_actor_pool(refs, payload)
+            elif kind == "repartition":
+                refs = iter(self._repartition(list(refs), payload.n))
+            elif kind == "shuffle":
+                refs = iter(self._shuffle(list(refs), payload.seed))
+            elif kind == "sort":
+                refs = iter(self._sort(list(refs), payload))
+            elif kind == "limit":
+                refs = self._limit_iter(refs, payload.n)
+            else:  # pragma: no cover
+                raise ValueError(f"unknown stage {kind}")
+
+        n = 0
+        for ref in _windowed(refs, max(1, prefetch)):
+            n += 1
+            yield ref
+        self.stats.num_blocks = n
+        self.stats.wall_s = _time.perf_counter() - t0
+
+    def _launch_fused(self, refs: Iterator[Any], ops: List[_Op]) -> Iterator[Any]:
+        @api.remote
+        def do_transform(block: Block, ops=ops) -> Block:
+            return _apply_fused(block, ops)
+
+        return (do_transform.remote(r) for r in refs)
+
+    def _launch_actor_pool(self, refs: Iterator[Any], op: _Op) -> Iterator[Any]:
+        import cloudpickle
+
+        n_actors = max(1, op.concurrency or 1)
+        actor_cls = api.remote(max_concurrency=2)(_BatchMapActor)
+        blob = cloudpickle.dumps(op.fn)
+        actors = [actor_cls.remote(blob) for _ in _range(n_actors)]
+        return (
+            actors[i % n_actors].apply.remote(r, op.batch_size, op.batch_format)
+            for i, r in enumerate(refs)
+        )
+
+    def _repartition(self, refs: List[Any], n: int) -> List[Any]:
+        blocks = api.get(refs)
+        whole = concat_blocks(blocks)
+        acc = BlockAccessor(whole)
+        total = acc.num_rows()
+        n = max(1, n)
+        per = (total + n - 1) // n if total else 0
+        out = []
+        for start in _range(0, total, per or 1):
+            out.append(api.put(acc.slice(start, min(start + per, total))))
+            if len(out) == n:
+                break
+        return out or [api.put(whole)]
+
+    def _shuffle(self, refs: List[Any], seed: Optional[int]) -> List[Any]:
+        n_out = max(1, len(refs))
+        blocks = api.get(refs)
+        rows = []
+        for b in blocks:
+            rows.extend(BlockAccessor(b).iter_rows())
+        rng = random.Random(seed)
+        rng.shuffle(rows)
+        per = (len(rows) + n_out - 1) // n_out if rows else 1
+        return [
+            api.put(block_from_rows(rows[i : i + per])) for i in _range(0, len(rows), per)
+        ] or [api.put(block_from_rows([]))]
+
+    def _sort(self, refs: List[Any], op: _Op) -> List[Any]:
+        blocks = api.get(refs)
+        rows = []
+        for b in blocks:
+            rows.extend(BlockAccessor(b).iter_rows())
+        rows.sort(key=lambda r: r[op.key], reverse=op.descending)
+        return [api.put(block_from_rows(rows))]
+
+    def _limit_iter(self, refs: Iterator[Any], n: int) -> Iterator[Any]:
+        """Streaming limit: stops pulling upstream once n rows are covered,
+        so the rest of the dataset is never read."""
+        taken = 0
+        for r in refs:
+            if taken >= n:
+                return
+            block = api.get(r)
+            acc = BlockAccessor(block)
+            rows = acc.num_rows()
+            if taken + rows <= n:
+                taken += rows
+                yield r
+            else:
+                yield api.put(acc.slice(0, n - taken))
+                return
+
+    # ---------------------------------------------------------- consumers
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "numpy",
+        prefetch_batches: int = 2,
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+    ) -> Iterator[Any]:
+        """(reference: dataset.py:3844 via iterator.py)"""
+        from .iterator import rebatch_blocks
+
+        def block_iter():
+            for ref in self.iter_block_refs():
+                yield api.get(ref)
+
+        yield from rebatch_blocks(
+            block_iter(),
+            batch_size=batch_size,
+            batch_format=batch_format,
+            drop_last=drop_last,
+            shuffle_buffer_size=local_shuffle_buffer_size,
+            shuffle_seed=local_shuffle_seed,
+        )
+
+    def iter_rows(self) -> Iterator[Any]:
+        for ref in self.iter_block_refs():
+            yield from BlockAccessor(api.get(ref)).iter_rows()
+
+    def take(self, n: int = 20) -> List[Any]:
+        return list(itertools.islice(self.iter_rows(), n))
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(BlockAccessor(api.get(r)).num_rows() for r in self.iter_block_refs())
+
+    def schema(self):
+        for ref in self.iter_block_refs():
+            return BlockAccessor(api.get(ref)).schema()
+        return None
+
+    def materialize(self) -> "Dataset":
+        refs = list(self.iter_block_refs())
+        return Dataset([_Op(kind="input", blocks=refs)])
+
+    def num_blocks(self) -> int:
+        return len(list(self.iter_block_refs()))
+
+    # ------------------------------------------------------------- splits
+    def split(self, n: int) -> List["Dataset"]:
+        """Materializing split into n datasets (reference: dataset.py split)."""
+        refs = list(self.iter_block_refs())
+        if len(refs) < n:
+            refs = self._repartition(refs, n)
+        shards: List[List[Any]] = [[] for _ in _range(n)]
+        for i, r in enumerate(refs):
+            shards[i % n].append(r)
+        return [Dataset([_Op(kind="input", blocks=s)]) for s in shards]
+
+    def streaming_split(self, n: int, *, equal: bool = True, locality_hints=None):
+        """N coordinated iterators, one per training worker (reference:
+        dataset.py:1387, SplitCoordinator actor stream_split_iterator.py:124).
+
+        equal=True slices shards to identical row counts (dropping the
+        remainder) — required for SPMD training where every worker must step
+        the same number of batches or a collective hangs. locality_hints is
+        accepted for API parity; the thread-based runtime has no locality."""
+        from .iterator import make_streaming_split
+
+        return make_streaming_split(self, n, equal=equal)
+
+    # -------------------------------------------------------------- sinks
+    def write_parquet(self, path: str) -> List[str]:
+        @api.remote
+        def do_write(block: Block, idx: int) -> str:
+            return write_parquet_block(block, path, idx)
+
+        return api.get(
+            [do_write.remote(r, i) for i, r in enumerate(self.iter_block_refs())]
+        )
+
+    def __repr__(self):
+        kinds = [op.kind for op in self._ops]
+        return f"Dataset({' -> '.join(kinds)})"
+
+
+# ----------------------------------------------------------- constructors
+# (reference: python/ray/data/read_api.py)
+
+
+def range(n: int, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:  # noqa: A001
+    return Dataset([_Op(kind="read", datasource=RangeDatasource(n), parallelism=parallelism)])
+
+
+def from_items(items: List[Any], *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    return Dataset([_Op(kind="read", datasource=ItemsDatasource(items), parallelism=parallelism)])
+
+
+def from_numpy(arrays: Dict[str, np.ndarray], *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    return Dataset([_Op(kind="read", datasource=NumpyDatasource(arrays), parallelism=parallelism)])
+
+
+def from_pandas(df, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    import pyarrow as pa
+
+    table = pa.Table.from_pandas(df, preserve_index=False)
+    arrays = {name: np.asarray(table.column(name).combine_chunks()) for name in table.schema.names}
+    return from_numpy(arrays, parallelism=parallelism)
+
+
+def read_parquet(paths, *, columns=None, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    return Dataset(
+        [_Op(kind="read", datasource=ParquetDatasource(paths, columns), parallelism=parallelism)]
+    )
+
+
+def read_csv(paths, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    return Dataset([_Op(kind="read", datasource=CSVDatasource(paths), parallelism=parallelism)])
+
+
+def read_json(paths, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    return Dataset([_Op(kind="read", datasource=JSONDatasource(paths), parallelism=parallelism)])

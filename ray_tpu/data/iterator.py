@@ -1,0 +1,194 @@
+"""Batch iteration + streaming split for training workers.
+
+Re-design of the reference's DataIterator / StreamSplitDataIterator
+(reference: python/ray/data/iterator.py,
+_internal/iterator/stream_split_iterator.py:32 with the SplitCoordinator
+actor at :124). TPU addition: `iter_device_batches` lands each host's
+shard directly with `device_put` against the worker's mesh sharding — the
+plasma->HBM boundary SURVEY.md §7 calls out.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from .. import api
+from .block import Block, BlockAccessor, block_from_rows
+
+
+def rebatch_blocks(
+    blocks: Iterator[Block],
+    *,
+    batch_size: Optional[int],
+    batch_format: str = "numpy",
+    drop_last: bool = False,
+    shuffle_buffer_size: Optional[int] = None,
+    shuffle_seed: Optional[int] = None,
+) -> Iterator[Any]:
+    """Re-slices a stream of blocks into fixed-size batches, with optional
+    local shuffle buffer (reference: _internal/block_batching/)."""
+    rng = random.Random(shuffle_seed)
+    row_buffer: List[Any] = []
+
+    for block in blocks:
+        row_buffer.extend(BlockAccessor(block).iter_rows())
+        if shuffle_buffer_size and len(row_buffer) < shuffle_buffer_size:
+            continue
+        while batch_size and len(row_buffer) >= batch_size:
+            if shuffle_buffer_size:
+                rng.shuffle(row_buffer)
+            chunk, row_buffer[:] = row_buffer[:batch_size], row_buffer[batch_size:]
+            yield _format_rows(chunk, batch_format)
+    # Tail: shuffle once if requested (covers buffers that never reached
+    # shuffle_buffer_size — otherwise a large buffer silently disables
+    # shuffling for the whole stream).
+    if shuffle_buffer_size and row_buffer:
+        rng.shuffle(row_buffer)
+    while row_buffer:
+        if batch_size is None:
+            chunk, row_buffer[:] = row_buffer[:], []
+        elif len(row_buffer) >= batch_size:
+            chunk, row_buffer[:] = row_buffer[:batch_size], row_buffer[batch_size:]
+        elif drop_last:
+            break
+        else:
+            chunk, row_buffer[:] = row_buffer[:], []
+        yield _format_rows(chunk, batch_format)
+
+
+def _format_rows(rows: List[Any], batch_format: str) -> Any:
+    block = block_from_rows(rows)
+    return BlockAccessor(block).to_batch(batch_format)
+
+
+class DataIterator:
+    """One worker's view of a dataset shard."""
+
+    def __init__(self, block_ref_fn: Callable[[], Iterator[Any]]):
+        self._block_ref_fn = block_ref_fn
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+        prefetch_batches: int = 2,
+    ) -> Iterator[Any]:
+        def block_iter():
+            for ref in self._block_ref_fn():
+                yield api.get(ref)
+
+        yield from rebatch_blocks(
+            block_iter(),
+            batch_size=batch_size,
+            batch_format=batch_format,
+            drop_last=drop_last,
+            shuffle_buffer_size=local_shuffle_buffer_size,
+            shuffle_seed=local_shuffle_seed,
+        )
+
+    def iter_device_batches(
+        self,
+        *,
+        batch_size: int,
+        mesh=None,
+        drop_last: bool = True,
+        batch_format: str = "numpy",
+    ) -> Iterator[Any]:
+        """Batches placed on device: numpy -> jax arrays sharded over the
+        mesh's batch axes (the device-feed boundary, SURVEY.md §7)."""
+        from ..parallel.sharding import shard_batch
+
+        for batch in self.iter_batches(
+            batch_size=batch_size, batch_format=batch_format, drop_last=drop_last
+        ):
+            if mesh is not None:
+                yield shard_batch(batch, mesh)
+            else:
+                import jax
+
+                yield jax.tree_util.tree_map(jax.numpy.asarray, batch)
+
+
+class SplitCoordinator:
+    """Actor distributing one stream of blocks to n consumers
+    (reference: stream_split_iterator.py:124). Each epoch's split is
+    computed once and cached, so workers iterating at different rates all
+    see the SAME data for the same epoch (no re-execution rewind)."""
+
+    def __init__(self, dataset_blob: bytes, n: int, equal: bool):
+        import cloudpickle
+
+        self._dataset = cloudpickle.loads(dataset_blob)
+        self._n = n
+        self._equal = equal
+        self._lock = threading.Lock()
+        self._epochs: Dict[int, List[List[Any]]] = {}
+
+    def _compute_epoch(self) -> List[List[Any]]:
+        refs = list(self._dataset.iter_block_refs())
+        if not self._equal:
+            shards: List[List[Any]] = [[] for _ in range(self._n)]
+            for i, r in enumerate(refs):
+                shards[i % self._n].append(r)
+            return shards
+        # equal=True: slice to identical row counts, dropping the remainder
+        # (SPMD workers must step in lockstep).
+        blocks = [api.get(r) for r in refs]
+        accs = [BlockAccessor(b) for b in blocks]
+        total = sum(a.num_rows() for a in accs)
+        per = total // self._n
+        shards = []
+        bi, off = 0, 0  # (block index, row offset) cursor
+        for s in range(self._n):
+            need = per
+            shard_refs: List[Any] = []
+            while need > 0 and bi < len(accs):
+                avail = accs[bi].num_rows() - off
+                take = min(avail, need)
+                if take == avail and off == 0:
+                    shard_refs.append(refs[bi])
+                else:
+                    shard_refs.append(api.put(accs[bi].slice(off, off + take)))
+                need -= take
+                off += take
+                if off >= accs[bi].num_rows():
+                    bi, off = bi + 1, 0
+            shards.append(shard_refs)
+        return shards
+
+    def get_shard_blocks(self, shard: int, epoch: int) -> List[Any]:
+        with self._lock:
+            if epoch not in self._epochs:
+                self._epochs[epoch] = self._compute_epoch()
+                # Retain a small history so lagging workers can finish; old
+                # epochs beyond that are dropped to bound memory.
+                for old in [e for e in self._epochs if e < epoch - 1]:
+                    del self._epochs[old]
+            return list(self._epochs[epoch][shard])
+
+
+def make_streaming_split(dataset, n: int, *, equal: bool = True) -> List[DataIterator]:
+    import cloudpickle
+
+    api_remote = api.remote(max_concurrency=max(2, n))(SplitCoordinator)
+    coordinator = api_remote.remote(cloudpickle.dumps(dataset), n, equal)
+    epochs = [0] * n
+
+    def make_fn(shard: int) -> Callable[[], Iterator[Any]]:
+        def fn():
+            epoch = epochs[shard]
+            epochs[shard] += 1
+            refs = api.get(coordinator.get_shard_blocks.remote(shard, epoch))
+            yield from refs
+
+        return fn
+
+    return [DataIterator(make_fn(i)) for i in range(n)]
