@@ -16,6 +16,13 @@ from typing import Any, Dict, Optional
 
 from .checkpoint import Checkpoint
 
+
+class TrialAborted(BaseException):
+    """Raised inside a training thread when the controller cancels the
+    trial; derives from BaseException so user `except Exception` blocks
+    don't swallow the unwind."""
+
+
 _session_lock = threading.Lock()
 _session: Optional["TrainSession"] = None
 # Thread-keyed registry: in the thread-based local runtime all worker
@@ -42,10 +49,19 @@ class TrainSession:
         # (reference: session.py:204).
         self._result_queue: "queue.Queue" = queue.Queue(maxsize=1)
         self._finished = threading.Event()
+        self._cancelled = threading.Event()
 
     # ------------------------------------------------------------ user API
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
-        self._result_queue.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+        payload = {"metrics": dict(metrics), "checkpoint": checkpoint}
+        while True:
+            if self._cancelled.is_set():
+                raise TrialAborted()
+            try:
+                self._result_queue.put(payload, timeout=0.1)
+                return
+            except queue.Full:
+                continue
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self._starting_checkpoint
@@ -70,6 +86,15 @@ class TrainSession:
 
     def mark_finished(self):
         self._finished.set()
+
+    def cancel(self):
+        """Controller-side abort: unblocks a report() in flight and makes
+        the training thread unwind with TrialAborted at its next report."""
+        self._cancelled.set()
+        try:
+            self._result_queue.get_nowait()
+        except queue.Empty:
+            pass
 
     # --------------------------------------------------- thread attachment
     def attach_to_current_thread(self) -> None:

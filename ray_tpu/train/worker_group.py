@@ -66,12 +66,16 @@ class _TrainWorker:
         self._session = session
 
         def run():
+            from .session import TrialAborted
+
             session.attach_to_current_thread()
             try:
                 if _takes_config(fn):
                     fn(config)
                 else:
                     fn()
+            except TrialAborted:
+                pass  # controller-initiated stop; not an error
             except BaseException as e:  # noqa: BLE001
                 self._error = e
             finally:
@@ -93,6 +97,14 @@ class _TrainWorker:
             out = dict(out)
             out["checkpoint"] = out["checkpoint"].path
         return out
+
+    def stop_training(self):
+        """Cancels the running training thread: the next report() inside the
+        user function raises TrialAborted and the thread unwinds (no zombie
+        threads blocked on the size-1 queue)."""
+        if self._session is not None:
+            self._session.cancel()
+        return True
 
     def join(self):
         if self._thread is not None:

@@ -1,0 +1,33 @@
+"""ray_tpu.tune: hyperparameter search and trial orchestration
+(re-design of the reference's Ray Tune, SURVEY.md §2e)."""
+
+from .schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from .search import (
+    BasicVariantGenerator,
+    Searcher,
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .tuner import ResultGrid, Trial, TuneConfig, Tuner, get_checkpoint, report
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+__all__ = [
+    "ASHAScheduler", "AsyncHyperBandScheduler", "BasicVariantGenerator",
+    "FIFOScheduler", "MedianStoppingRule", "PopulationBasedTraining",
+    "ResultGrid", "Searcher", "Trial", "TrialScheduler", "TuneConfig",
+    "Tuner", "choice", "get_checkpoint", "grid_search", "lograndint",
+    "loguniform", "quniform", "randint", "report", "sample_from", "uniform",
+]

@@ -1,0 +1,170 @@
+"""Search spaces and trial-config generation.
+
+Re-design of the reference's tune.search (reference:
+python/ray/tune/search/sample.py domains; basic_variant.py:189
+BasicVariantGenerator for grid/random; searcher.py:21 Searcher ABC).
+External searcher wrappers (Optuna/HyperOpt/...) are pluggable via the
+same Searcher ABC but not bundled.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+# ------------------------------------------------------------------ domains
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Categorical(Domain):
+    categories: List[Any]
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+@dataclass
+class Float(Domain):
+    lower: float
+    upper: float
+    log: bool = False
+    q: Optional[float] = None
+
+    def sample(self, rng):
+        if self.log:
+            v = math.exp(rng.uniform(math.log(self.lower), math.log(self.upper)))
+        else:
+            v = rng.uniform(self.lower, self.upper)
+        if self.q:
+            v = round(v / self.q) * self.q
+        return v
+
+
+@dataclass
+class Integer(Domain):
+    lower: int
+    upper: int  # exclusive, like the reference's randint
+    log: bool = False
+
+    def sample(self, rng):
+        if self.log:
+            v = int(math.exp(rng.uniform(math.log(self.lower), math.log(self.upper - 1))))
+            return max(self.lower, min(v, self.upper - 1))
+        return rng.randrange(self.lower, self.upper)
+
+
+@dataclass
+class FunctionDomain(Domain):
+    fn: Callable[[], Any]
+
+    def sample(self, rng):
+        return self.fn()
+
+
+@dataclass
+class GridSearch:
+    values: List[Any]
+
+
+def choice(categories: List[Any]) -> Categorical:
+    return Categorical(list(categories))
+
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def quniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, q=q)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def lograndint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper, log=True)
+
+
+def sample_from(fn: Callable[[], Any]) -> FunctionDomain:
+    return FunctionDomain(fn)
+
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(list(values))
+
+
+# ---------------------------------------------------------------- searchers
+
+
+class Searcher:
+    """ABC (reference: tune/search/searcher.py:21)."""
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(
+        self, trial_id: str, result: Optional[Dict[str, Any]] = None, error: bool = False
+    ) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cross-product x num_samples random sampling
+    (reference: tune/search/basic_variant.py:189)."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1, seed: Optional[int] = None):
+        self._rng = random.Random(seed)
+        self._variants = list(self._expand(param_space, num_samples))
+        self._i = 0
+
+    def _expand(self, space: Dict[str, Any], num_samples: int) -> Iterator[Dict[str, Any]]:
+        grid_keys = [k for k, v in space.items() if isinstance(v, GridSearch)]
+
+        def grid_product(idx: int, acc: Dict[str, Any]) -> Iterator[Dict[str, Any]]:
+            if idx == len(grid_keys):
+                yield dict(acc)
+                return
+            k = grid_keys[idx]
+            for v in space[k].values:
+                acc[k] = v
+                yield from grid_product(idx + 1, acc)
+                del acc[k]
+
+        for _ in range(num_samples):
+            for grid_combo in grid_product(0, {}):
+                cfg = {}
+                for k, v in space.items():
+                    if isinstance(v, GridSearch):
+                        cfg[k] = grid_combo[k]
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self._rng)
+                    else:
+                        cfg[k] = v
+                yield cfg
+
+    @property
+    def total_trials(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._i >= len(self._variants):
+            return None
+        cfg = self._variants[self._i]
+        self._i += 1
+        return cfg

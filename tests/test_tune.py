@@ -1,0 +1,205 @@
+"""Tune controller/search/scheduler tests (patterned on the reference's
+tune/tests, SURVEY.md §4)."""
+
+import pytest
+
+
+@pytest.fixture
+def rt():
+    import ray_tpu as rtpu
+
+    rtpu.shutdown()
+    rtpu.init(local_mode=True, num_cpus=8)
+    yield rtpu
+    rtpu.shutdown()
+
+
+def test_grid_and_random_spaces():
+    from ray_tpu.tune.search import BasicVariantGenerator, choice, grid_search, uniform
+
+    gen = BasicVariantGenerator(
+        {"a": grid_search([1, 2, 3]), "b": uniform(0.0, 1.0), "c": choice(["x", "y"]), "d": 7},
+        num_samples=2,
+        seed=0,
+    )
+    cfgs = [gen.suggest(f"t{i}") for i in range(gen.total_trials)]
+    assert len(cfgs) == 6  # 3 grid values x 2 samples
+    assert gen.suggest("extra") is None
+    assert {c["a"] for c in cfgs} == {1, 2, 3}
+    assert all(0.0 <= c["b"] <= 1.0 and c["c"] in ("x", "y") and c["d"] == 7 for c in cfgs)
+
+
+def test_tuner_grid_search_end_to_end(rt, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.train import RunConfig
+
+    def objective(config):
+        score = -((config["x"] - 3) ** 2)
+        tune.report({"score": score})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4, 5])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="quad", storage_path=str(tmp_path)),
+    )
+    results = grid.fit()
+    assert len(results) == 6
+    assert not results.errors
+    best = results.get_best_result()
+    assert best.metrics["score"] == 0  # x == 3
+
+
+def test_asha_stops_bad_trials(rt, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.train import RunConfig
+
+    def objective(config):
+        for step in range(20):
+            tune.report({"acc": config["quality"] * (step + 1)})
+
+    # Strong trials launch first (max_concurrent=2), filling the rungs; the
+    # weak trials then arrive below the recorded cutoffs and stop early —
+    # the deterministic ASHA scenario (async arrivals before any recording
+    # are legitimately promoted).
+    results = tune.Tuner(
+        objective,
+        param_space={"quality": tune.grid_search([1.0, 0.5, 0.02, 0.01])},
+        tune_config=tune.TuneConfig(
+            metric="acc",
+            mode="max",
+            max_concurrent_trials=2,
+            scheduler=tune.ASHAScheduler(
+                metric="acc", mode="max", grace_period=2, reduction_factor=2, max_t=20
+            ),
+        ),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    ).fit()
+    # best trial survived to max_t; at least one bad trial was stopped early
+    iters = {r.metrics["trial_id"]: r.metrics["training_iteration"] for r in results}
+    assert max(iters.values()) >= 19
+    assert min(iters.values()) < 19
+
+
+def test_pbt_exploits_checkpoints(rt, tmp_path):
+    import tempfile
+
+    from ray_tpu import tune
+    from ray_tpu.train import Checkpoint, RunConfig, load_pytree, save_pytree
+
+    def objective(config):
+        # "weights" = accumulated score; good lr grows faster
+        ck = tune.get_checkpoint()
+        w = float(load_pytree(ck.path)["w"]) if ck else 0.0
+        for _ in range(12):
+            w += config["lr"]
+            d = tempfile.mkdtemp(prefix="pbt-")
+            save_pytree({"w": w}, d)
+            tune.report({"w": w}, checkpoint=Checkpoint(d))
+
+    pbt = tune.PopulationBasedTraining(
+        metric="w",
+        mode="max",
+        perturbation_interval=3,
+        hyperparam_mutations={"lr": tune.uniform(0.5, 1.0)},
+        seed=0,
+    )
+    results = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.001, 1.0])},
+        tune_config=tune.TuneConfig(metric="w", mode="max", scheduler=pbt),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)),
+    ).fit()
+    assert not results.errors
+    # The weak trial must have been pulled up by exploiting the strong one's
+    # checkpoint: its final w far exceeds what lr=0.001 alone could reach
+    # (12 * 0.001 = 0.012).
+    finals = sorted(r.metrics["w"] for r in results)
+    assert finals[0] > 1.0
+
+
+def test_tuner_wraps_jax_trainer(rt, tmp_path):
+    """JaxTrainer as trainable: single-trial-per-config sweep
+    (reference: base_trainer.py:567 fit-via-Tune)."""
+    from ray_tpu import tune
+    from ray_tpu.parallel import MeshSpec
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def train_loop(config):
+        from ray_tpu import train as rt_train
+
+        rt_train.report({"final": config["scale"] * 10})
+
+    trainer = JaxTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(num_workers=1, mesh=MeshSpec(data=-1)),
+        run_config=RunConfig(storage_path=str(tmp_path / "inner")),
+    )
+    results = tune.Tuner(
+        trainer,
+        param_space={"scale": tune.grid_search([1, 5])},
+        tune_config=tune.TuneConfig(metric="final", mode="max"),
+        run_config=RunConfig(name="wrap", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 2
+    assert results.get_best_result().metrics["final"] == 50
+
+
+def test_experiment_state_saved(rt, tmp_path):
+    import json
+    import os
+
+    from ray_tpu import tune
+    from ray_tpu.train import RunConfig
+
+    def objective(config):
+        tune.report({"v": 1})
+
+    tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2])},
+        run_config=RunConfig(name="state", storage_path=str(tmp_path)),
+    ).fit()
+    state_file = tmp_path / "state" / "experiment_state.json"
+    assert state_file.exists()
+    state = json.loads(state_file.read_text())
+    assert len(state["trials"]) == 2
+    assert all(t["status"] == "TERMINATED" for t in state["trials"])
+
+
+def test_tuner_restore_resumes_unfinished(rt, tmp_path):
+    """Tuner.restore: terminated trials keep results; unfinished trials
+    relaunch from their checkpoints."""
+    import json
+    import os
+
+    from ray_tpu import tune
+    from ray_tpu.train import RunConfig
+
+    def objective(config):
+        tune.report({"v": config["x"] * 100})
+
+    # Simulate an interrupted experiment: one terminated, one pending.
+    exp_dir = tmp_path / "resume_me"
+    os.makedirs(exp_dir)
+    state = {
+        "name": "resume_me",
+        "metric": "v",
+        "mode": "max",
+        "trials": [
+            {"trial_id": "trial_00000", "config": {"x": 1}, "status": "TERMINATED",
+             "last_result": {"v": 100, "trial_id": "trial_00000"}, "iterations": 1,
+             "error": None, "checkpoint_index": 0, "latest_checkpoint": None},
+            {"trial_id": "trial_00001", "config": {"x": 7}, "status": "RUNNING",
+             "last_result": {}, "iterations": 0,
+             "error": None, "checkpoint_index": 0, "latest_checkpoint": None},
+        ],
+    }
+    (exp_dir / "experiment_state.json").write_text(json.dumps(state))
+
+    tuner = tune.Tuner.restore(str(exp_dir), objective)
+    results = tuner.fit()
+    assert len(results) == 2
+    by_id = {r.metrics.get("trial_id"): r.metrics for r in results}
+    assert by_id["trial_00000"]["v"] == 100  # carried over, not re-run
+    assert by_id["trial_00001"]["v"] == 700  # resumed and completed
