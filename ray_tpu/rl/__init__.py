@@ -1,0 +1,24 @@
+"""ray_tpu.rl: RL training stack (re-design of the reference's RLlib new
+API stack, SURVEY.md §2g): RLModule (jax), EnvRunner (gymnasium),
+JaxLearner (jitted optax update, in-program psum instead of NCCL DDP),
+PPO and IMPALA."""
+
+from .env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from .impala import IMPALA, IMPALAConfig, impala_loss, vtrace
+from .learner import JaxLearner, LearnerGroup
+from .module import (
+    DiscretePolicyConfig,
+    DiscretePolicyModule,
+    RLModule,
+    logp_entropy,
+    sample_actions,
+)
+from .ppo import PPO, PPOConfig, compute_gae, ppo_loss
+
+__all__ = [
+    "EnvRunnerGroup", "SingleAgentEnvRunner", "IMPALA", "IMPALAConfig",
+    "impala_loss", "vtrace", "JaxLearner", "LearnerGroup",
+    "DiscretePolicyConfig", "DiscretePolicyModule", "RLModule",
+    "logp_entropy", "sample_actions", "PPO", "PPOConfig", "compute_gae",
+    "ppo_loss",
+]

@@ -1,0 +1,191 @@
+"""EnvRunner: vectorized gymnasium sampling actors.
+
+Re-design of the reference's EnvRunner stack (reference:
+rllib/env/env_runner.py:28 ABC; single_agent_env_runner.py:64, sample
+:134; env_runner_group.py:70). An env runner holds a vector env + the
+inference-only copy of the module params; `sample(num_steps)` steps the
+envs through forward_exploration and returns flat numpy rollouts.
+Env-side compute stays on CPU numpy — device hops per step would dominate
+at CartPole scale; the jitted policy runs on the host's default backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import api
+from .module import RLModule, sample_actions
+
+
+class SingleAgentEnvRunner:
+    """One sampling worker (reference: single_agent_env_runner.py:64)."""
+
+    def __init__(self, env_name: str, module_blob: bytes, num_envs: int, seed: int = 0):
+        import cloudpickle
+        import gymnasium as gym
+        import jax
+
+        self._jax = jax
+        self.envs = gym.make_vec(env_name, num_envs=num_envs)
+        self.module: RLModule = cloudpickle.loads(module_blob)
+        self.num_envs = num_envs
+        self._key = jax.random.PRNGKey(seed)
+        self._params = None
+        obs, _ = self.envs.reset(seed=seed)
+        self._obs = self._flatten(obs)
+        self._episode_returns = np.zeros(num_envs)
+        self._completed_returns: List[float] = []
+        # gymnasium >=1.0 NEXT_STEP autoreset: the step after done=True is a
+        # reset-padding step whose action is ignored; mask it out of training.
+        self._prev_done = np.zeros(num_envs, np.float32)
+
+        self._infer = jax.jit(self.module.forward_exploration)
+
+    @staticmethod
+    def _flatten(obs: np.ndarray) -> np.ndarray:
+        """Multi-dim observations flatten to the MLP's input layout."""
+        return np.asarray(obs, np.float32).reshape(obs.shape[0], -1)
+
+    def set_weights(self, params) -> bool:
+        self._params = params
+        return True
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Rollout num_steps per env; returns [T, N, ...] arrays
+        (reference: sample() :134)."""
+        import jax
+
+        assert self._params is not None, "set_weights before sample"
+        T, N = num_steps, self.num_envs
+        obs_buf = np.zeros((T, N) + self._obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, N), np.int64)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+        mask_buf = np.zeros((T, N), np.float32)  # 0 = autoreset padding step
+
+        obs = self._obs
+        for t in range(T):
+            out = self._infer(self._params, obs)
+            self._key, sub = jax.random.split(self._key)
+            action, logp = sample_actions(sub, out["logits"])
+            action = np.asarray(action)
+            obs_buf[t] = obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(out["vf"])
+            mask_buf[t] = 1.0 - self._prev_done
+            obs, rew, terminated, truncated, _ = self.envs.step(action)
+            obs = self._flatten(obs)
+            done = np.logical_or(terminated, truncated)
+            rew_buf[t] = rew
+            done_buf[t] = done
+            self._episode_returns += rew
+            for i in np.nonzero(done)[0]:
+                self._completed_returns.append(float(self._episode_returns[i]))
+                self._episode_returns[i] = 0.0
+            self._prev_done = done.astype(np.float32)
+        self._obs = obs
+
+        # Bootstrap value for the final observation (GAE tail); last_obs lets
+        # off-policy learners (vtrace) recompute it under current params.
+        last_val = np.asarray(self._infer(self._params, obs)["vf"])
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "logp": logp_buf,
+            "values": val_buf,
+            "rewards": rew_buf,
+            "dones": done_buf,
+            "mask": mask_buf,
+            "last_obs": obs.copy(),
+            "last_values": last_val,
+        }
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        out = list(self._completed_returns)
+        if clear:
+            self._completed_returns = []
+        return out
+
+    def ping(self) -> bool:
+        return True
+
+
+class EnvRunnerGroup:
+    """Fault-tolerant group of env-runner actors (reference:
+    env_runner_group.py:70 + utils/actor_manager.py FaultTolerantActorManager:
+    probe and replace dead runners instead of failing the run)."""
+
+    def __init__(
+        self,
+        env_name: str,
+        module: RLModule,
+        *,
+        num_runners: int = 2,
+        num_envs_per_runner: int = 4,
+        seed: int = 0,
+    ):
+        import cloudpickle
+
+        self._env_name = env_name
+        self._module_blob = cloudpickle.dumps(module)
+        self._num_envs = num_envs_per_runner
+        self._seed = seed
+        self._restarts = 0
+        self._last_weights_ref = None  # re-seeds replacement runners
+        self._cls = api.remote(max_concurrency=1)(SingleAgentEnvRunner)
+        self._runners = [
+            self._make_runner(i) for i in range(num_runners)
+        ]
+
+    def _make_runner(self, idx: int):
+        runner = self._cls.remote(
+            self._env_name, self._module_blob, self._num_envs, self._seed + 1000 * idx
+        )
+        if self._last_weights_ref is not None:
+            api.get(runner.set_weights.remote(self._last_weights_ref))
+        return runner
+
+    def replace_runner(self, runner) -> Any:
+        """Swaps a dead runner for a fresh one (with current weights) and
+        returns the replacement (reference: actor_manager.py:641
+        probe_unhealthy_actors + restart)."""
+        for i, r in enumerate(self._runners):
+            if r is runner or r._id == getattr(runner, "_id", None):
+                self._restarts += 1
+                self._runners[i] = self._make_runner(i)
+                return self._runners[i]
+        raise ValueError("runner not in group")
+
+    @property
+    def runners(self):
+        return list(self._runners)
+
+    @property
+    def num_restarts(self) -> int:
+        return self._restarts
+
+    def sync_weights(self, params) -> None:
+        self._last_weights_ref = api.put(params)
+        api.get([r.set_weights.remote(self._last_weights_ref) for r in self._runners])
+
+    def sample(self, num_steps_per_runner: int) -> List[Dict[str, np.ndarray]]:
+        refs = [r.sample.remote(num_steps_per_runner) for r in self._runners]
+        out = []
+        for i, ref in enumerate(refs):
+            try:
+                out.append(api.get(ref))
+            except Exception:
+                # Probe-and-restart (reference: actor_manager.py:641):
+                # replace the dead runner; its sample is skipped this round.
+                self._restarts += 1
+                self._runners[i] = self._make_runner(i)
+        return out
+
+    def episode_returns(self) -> List[float]:
+        outs = api.get([r.episode_returns.remote() for r in self._runners])
+        return [v for sub in outs for v in sub]

@@ -1,0 +1,104 @@
+"""RLModule: the neural-network abstraction of the new API stack, in jax.
+
+Re-design of the reference's RLModule (reference:
+rllib/core/rl_module/rl_module.py:258; torch impl core/rl_module/torch/).
+Functional: a module owns architecture + pure forward functions over an
+explicit param pytree — no DDP wrapper is needed because data-parallel
+gradient averaging happens in-program (psum over the mesh), replacing
+TorchDDPRLModule (reference: core/learner/torch/torch_learner.py:576-590).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+class RLModule:
+    """ABC. forward_* mirror the reference's inference/exploration/train
+    forwards (rl_module.py: forward_inference/forward_exploration/
+    forward_train)."""
+
+    def init_params(self, key: jax.Array) -> PyTree:
+        raise NotImplementedError
+
+    def forward_inference(self, params: PyTree, obs: jax.Array) -> Dict[str, jax.Array]:
+        raise NotImplementedError
+
+    def forward_exploration(self, params: PyTree, obs: jax.Array) -> Dict[str, jax.Array]:
+        return self.forward_inference(params, obs)
+
+    def forward_train(self, params: PyTree, obs: jax.Array) -> Dict[str, jax.Array]:
+        return self.forward_inference(params, obs)
+
+
+@dataclasses.dataclass(frozen=True)
+class DiscretePolicyConfig:
+    obs_dim: int
+    n_actions: int
+    hidden: Tuple[int, ...] = (64, 64)
+    dtype: Any = jnp.float32
+
+
+class DiscretePolicyModule(RLModule):
+    """Separate policy and value MLP heads over a shared spec (the default
+    PPO/IMPALA module for discrete action spaces — the analogue of the
+    reference's default MLP RLModule catalog entry)."""
+
+    def __init__(self, config: DiscretePolicyConfig):
+        self.config = config
+
+    def _mlp_params(self, key, dims):
+        layers = []
+        keys = jax.random.split(key, len(dims) - 1)
+        for k, din, dout in zip(keys, dims[:-1], dims[1:]):
+            layers.append(
+                {
+                    "w": (jax.random.normal(k, (din, dout)) * math.sqrt(2.0 / din)).astype(
+                        self.config.dtype
+                    ),
+                    "b": jnp.zeros((dout,), self.config.dtype),
+                }
+            )
+        return layers
+
+    def init_params(self, key: jax.Array) -> PyTree:
+        c = self.config
+        kp, kv = jax.random.split(key)
+        return {
+            "pi": self._mlp_params(kp, (c.obs_dim,) + c.hidden + (c.n_actions,)),
+            "vf": self._mlp_params(kv, (c.obs_dim,) + c.hidden + (1,)),
+        }
+
+    @staticmethod
+    def _mlp(layers, x):
+        for layer in layers[:-1]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        last = layers[-1]
+        return x @ last["w"] + last["b"]
+
+    def forward_inference(self, params, obs):
+        logits = self._mlp(params["pi"], obs)
+        value = self._mlp(params["vf"], obs)[..., 0]
+        return {"logits": logits, "vf": value}
+
+
+def sample_actions(key: jax.Array, logits: jax.Array):
+    """Categorical sample + logp (exploration path)."""
+    action = jax.random.categorical(key, logits)
+    logp = jax.nn.log_softmax(logits)
+    return action, jnp.take_along_axis(logp, action[..., None], axis=-1)[..., 0]
+
+
+def logp_entropy(logits: jax.Array, actions: jax.Array):
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(logp_all, actions[..., None], axis=-1)[..., 0]
+    p = jnp.exp(logp_all)
+    entropy = -jnp.sum(p * logp_all, axis=-1)
+    return logp, entropy

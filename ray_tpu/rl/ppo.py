@@ -1,0 +1,221 @@
+"""PPO on the new-API-stack equivalents.
+
+Re-design of the reference's PPO (reference: rllib/algorithms/ppo/ppo.py,
+training_step :400-466: synchronous_parallel_sample -> learner_group
+update -> env_runner weight sync; losses rllib/algorithms/ppo/torch/
+ppo_torch_learner.py). Loss and GAE are jitted jax; the update runs
+minibatch SGD epochs inside the learner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .env_runner import EnvRunnerGroup
+from .learner import LearnerGroup
+from .module import DiscretePolicyConfig, DiscretePolicyModule, RLModule, logp_entropy
+
+
+@dataclasses.dataclass
+class PPOConfig:
+    """Builder-style config (reference: algorithm_config.py:106 +
+    ppo.py PPOConfig)."""
+
+    env: str = "CartPole-v1"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_length: int = 64
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_param: float = 0.2
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    lr: float = 3e-4
+    grad_clip: Optional[float] = 0.5
+    num_epochs: int = 4
+    minibatch_size: int = 128
+    num_learners: int = 1
+    hidden: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+
+    # fluent-ish setters for call-site parity with the reference
+    def environment(self, env: str) -> "PPOConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int, num_envs_per_runner: int = 4) -> "PPOConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_runner
+        return self
+
+    def training(self, **kw) -> "PPOConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise AttributeError(k)
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+def compute_gae(rewards, values, dones, last_values, gamma: float, lam: float):
+    """Generalized advantage estimation over [T, N] arrays (reference:
+    rllib/evaluation/postprocessing.py compute_gae_for_sample_batch)."""
+    T = rewards.shape[0]
+    adv = np.zeros_like(rewards)
+    last_gae = np.zeros_like(rewards[0])
+    next_values = last_values
+    for t in reversed(range(T)):
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_values * nonterminal - values[t]
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+        next_values = values[t]
+    returns = adv + values
+    return adv, returns
+
+
+def ppo_loss(module: RLModule, params, batch, *, clip: float, vf_coeff: float, ent_coeff: float):
+    """Clipped surrogate + value loss + entropy bonus (reference:
+    ppo_torch_learner.py compute_loss_for_module). Autoreset padding steps
+    carry mask=0 and contribute nothing."""
+    out = module.forward_train(params, batch["obs"])
+    logp, entropy = logp_entropy(out["logits"], batch["actions"])
+    mask = batch.get("mask")
+    if mask is None:
+        mask = jnp.ones_like(logp)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+
+    def masked_mean(x):
+        return jnp.sum(x * mask) / denom
+
+    ratio = jnp.exp(logp - batch["logp"])
+    adv = batch["advantages"]
+    surrogate = jnp.minimum(ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+    policy_loss = -masked_mean(surrogate)
+    vf_loss = masked_mean((out["vf"] - batch["returns"]) ** 2)
+    ent = masked_mean(entropy)
+    total = policy_loss + vf_coeff * vf_loss - ent_coeff * ent
+    return total, {
+        "policy_loss": policy_loss,
+        "vf_loss": vf_loss,
+        "entropy": ent,
+        "kl_approx": masked_mean(batch["logp"] - logp),
+    }
+
+
+class PPO:
+    """(reference: Algorithm + PPO.training_step, ppo.py:400)"""
+
+    def __init__(self, config: PPOConfig):
+        import gymnasium as gym
+
+        self.config = config
+        probe = gym.make(config.env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        n_actions = int(probe.action_space.n)
+        probe.close()
+
+        self.module = DiscretePolicyModule(
+            DiscretePolicyConfig(obs_dim=obs_dim, n_actions=n_actions, hidden=config.hidden)
+        )
+        import functools
+
+        loss = functools.partial(
+            ppo_loss,
+            clip=config.clip_param,
+            vf_coeff=config.vf_coeff,
+            ent_coeff=config.entropy_coeff,
+        )
+        self.learner_group = LearnerGroup(
+            self.module,
+            loss,
+            num_learners=config.num_learners,
+            lr=config.lr,
+            grad_clip=config.grad_clip,
+            seed=config.seed,
+        )
+        self.env_runner_group = EnvRunnerGroup(
+            config.env,
+            self.module,
+            num_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_runner,
+            seed=config.seed,
+        )
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        self.iteration = 0
+        self._rng = np.random.default_rng(config.seed)
+
+    # -------------------------------------------------------------- train
+    def train(self) -> Dict[str, Any]:
+        """One training iteration (reference: ppo.py training_step :400)."""
+        cfg = self.config
+        rollouts = self.env_runner_group.sample(cfg.rollout_length)
+        if not rollouts:
+            return {"iteration": self.iteration, "no_samples": True}
+
+        # Assemble [B, ...] train batch with GAE.
+        parts = []
+        for ro in rollouts:
+            adv, ret = compute_gae(
+                ro["rewards"], ro["values"], ro["dones"], ro["last_values"],
+                cfg.gamma, cfg.gae_lambda,
+            )
+            flat = {
+                "obs": ro["obs"].reshape(-1, ro["obs"].shape[-1]),
+                "actions": ro["actions"].reshape(-1),
+                "logp": ro["logp"].reshape(-1),
+                "advantages": adv.reshape(-1),
+                "returns": ret.reshape(-1),
+                "mask": ro["mask"].reshape(-1),
+            }
+            parts.append(flat)
+        batch = {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+        # Advantage normalization over valid steps (standard PPO practice).
+        adv, m = batch["advantages"], batch["mask"]
+        mean = (adv * m).sum() / max(m.sum(), 1.0)
+        std = np.sqrt(((adv - mean) ** 2 * m).sum() / max(m.sum(), 1.0))
+        batch["advantages"] = (adv - mean) / (std + 1e-8)
+
+        B = batch["obs"].shape[0]
+        all_metrics: List[Dict[str, float]] = []
+        for _ in range(cfg.num_epochs):
+            perm = self._rng.permutation(B)
+            for start in range(0, B, cfg.minibatch_size):
+                idx = perm[start : start + cfg.minibatch_size]
+                mb = {k: v[idx] for k, v in batch.items()}
+                all_metrics.append(self.learner_group.update(mb))
+        metrics = {
+            k: float(np.mean([m[k] for m in all_metrics])) for k in all_metrics[0]
+        } if all_metrics else {}
+
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        self.iteration += 1
+
+        returns = self.env_runner_group.episode_returns()
+        result = {
+            "iteration": self.iteration,
+            "num_env_steps_sampled": B,
+            "episode_return_mean": float(np.mean(returns)) if returns else float("nan"),
+            "num_episodes": len(returns),
+            **metrics,
+        }
+        return result
+
+    # --------------------------------------------------------- checkpoint
+    def save(self, directory: str) -> None:
+        from ..train.checkpoint import save_pytree
+
+        save_pytree({"params": self.learner_group.get_weights()}, directory)
+
+    def restore(self, directory: str) -> None:
+        from ..train.checkpoint import load_pytree
+
+        params = load_pytree(directory)["params"]
+        self.learner_group.set_weights(params)
+        self.env_runner_group.sync_weights(params)
