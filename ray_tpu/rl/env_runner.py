@@ -64,10 +64,12 @@ class SingleAgentEnvRunner:
         logp_buf = np.zeros((T, N), np.float32)
         val_buf = np.zeros((T, N), np.float32)
         rew_buf = np.zeros((T, N), np.float32)
-        done_buf = np.zeros((T, N), np.float32)
+        term_buf = np.zeros((T, N), np.float32)  # terminated: no bootstrap
+        done_buf = np.zeros((T, N), np.float32)  # terminated OR truncated
         mask_buf = np.zeros((T, N), np.float32)  # 0 = autoreset padding step
 
         obs = self._obs
+        completed_this_sample: List[float] = []
         for t in range(T):
             out = self._infer(self._params, obs)
             self._key, sub = jax.random.split(self._key)
@@ -82,16 +84,23 @@ class SingleAgentEnvRunner:
             obs = self._flatten(obs)
             done = np.logical_or(terminated, truncated)
             rew_buf[t] = rew
+            term_buf[t] = terminated
             done_buf[t] = done
             self._episode_returns += rew
             for i in np.nonzero(done)[0]:
-                self._completed_returns.append(float(self._episode_returns[i]))
+                completed_this_sample.append(float(self._episode_returns[i]))
                 self._episode_returns[i] = 0.0
             self._prev_done = done.astype(np.float32)
         self._obs = obs
+        self._completed_returns.extend(completed_this_sample)
 
         # Bootstrap value for the final observation (GAE tail); last_obs lets
         # off-policy learners (vtrace) recompute it under current params.
+        # NOTE on truncation (time limits): gymnasium NEXT_STEP autoreset
+        # returns the episode's FINAL observation at the truncated step, so
+        # the padding row's value IS V(final_obs) — advantage estimators
+        # bootstrap through truncation ((1-terminated) on the delta) while
+        # the recursion still cuts at any episode boundary ((1-done)).
         last_val = np.asarray(self._infer(self._params, obs)["vf"])
         return {
             "obs": obs_buf,
@@ -99,10 +108,12 @@ class SingleAgentEnvRunner:
             "logp": logp_buf,
             "values": val_buf,
             "rewards": rew_buf,
+            "terminateds": term_buf,
             "dones": done_buf,
             "mask": mask_buf,
             "last_obs": obs.copy(),
             "last_values": last_val,
+            "episode_returns": completed_this_sample,
         }
 
     def episode_returns(self, clear: bool = True) -> List[float]:
@@ -168,6 +179,12 @@ class EnvRunnerGroup:
     @property
     def num_restarts(self) -> int:
         return self._restarts
+
+    def cache_weights(self, ref) -> None:
+        """Records the current-weights ref used to seed replacement runners
+        (for callers that push weights to runners individually, e.g.
+        IMPALA's per-runner broadcast)."""
+        self._last_weights_ref = ref
 
     def sync_weights(self, params) -> None:
         self._last_weights_ref = api.put(params)

@@ -21,7 +21,7 @@ import numpy as np
 from .. import api
 from .env_runner import EnvRunnerGroup
 from .learner import LearnerGroup
-from .module import DiscretePolicyConfig, DiscretePolicyModule, RLModule, logp_entropy
+from .module import RLModule, build_discrete_module, logp_entropy, masked_mean
 
 
 def vtrace(
@@ -35,28 +35,34 @@ def vtrace(
     gamma: float,
     clip_rho: float = 1.0,
     clip_c: float = 1.0,
+    terminateds=None,
 ):
     """V-trace targets over [T, N] tensors (jax, scan-based; reference:
     vtrace_torch.py / Espeholt et al. 2018 eq. 1).
 
+    Truncated episodes bootstrap through the time limit ((1-terminated) on
+    the delta) while the correction chain cuts at any boundary ((1-done)).
     Returns (vs, pg_advantages)."""
+    if terminateds is None:
+        terminateds = dones
     rho = jnp.minimum(jnp.exp(target_logp - behavior_logp), clip_rho)
     c = jnp.minimum(jnp.exp(target_logp - behavior_logp), clip_c)
-    discounts = gamma * (1.0 - dones)
+    bootstrap = gamma * (1.0 - terminateds)
+    chain = gamma * (1.0 - dones)
     values_tp1 = jnp.concatenate([values[1:], last_values[None]], axis=0)
-    deltas = rho * (rewards + discounts * values_tp1 - values)
+    deltas = rho * (rewards + bootstrap * values_tp1 - values)
 
     def backward(acc, xs):
-        delta_t, disc_t, c_t = xs
-        acc = delta_t + disc_t * c_t * acc
+        delta_t, chain_t, c_t = xs
+        acc = delta_t + chain_t * c_t * acc
         return acc, acc
 
     _, vs_minus_v = jax.lax.scan(
-        backward, jnp.zeros_like(values[0]), (deltas, discounts, c), reverse=True
+        backward, jnp.zeros_like(values[0]), (deltas, chain, c), reverse=True
     )
     vs = vs_minus_v + values
     vs_tp1 = jnp.concatenate([vs[1:], last_values[None]], axis=0)
-    pg_adv = rho * (rewards + discounts * vs_tp1 - values)
+    pg_adv = rho * (rewards + bootstrap * vs_tp1 - values)
     return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
 
 
@@ -83,19 +89,12 @@ def impala_loss(
     logp, entropy = logp_entropy(logits, batch["actions"])
     vs, pg_adv = vtrace(
         batch["logp"], logp, batch["rewards"], values, batch["dones"],
-        last_values, gamma=gamma,
+        last_values, gamma=gamma, terminateds=batch.get("terminateds"),
     )
     mask = batch.get("mask")
-    if mask is None:
-        mask = jnp.ones_like(logp)
-    denom = jnp.maximum(jnp.sum(mask), 1.0)
-
-    def masked_mean(x):
-        return jnp.sum(x * mask) / denom
-
-    policy_loss = -masked_mean(logp * pg_adv)
-    vf_loss = 0.5 * masked_mean((values - vs) ** 2)
-    ent = masked_mean(entropy)
+    policy_loss = -masked_mean(logp * pg_adv, mask)
+    vf_loss = 0.5 * masked_mean((values - vs) ** 2, mask)
+    ent = masked_mean(entropy, mask)
     total = policy_loss + vf_coeff * vf_loss - ent_coeff * ent
     return total, {"policy_loss": policy_loss, "vf_loss": vf_loss, "entropy": ent}
 
@@ -125,17 +124,8 @@ class IMPALA:
     def __init__(self, config: IMPALAConfig):
         import functools
 
-        import gymnasium as gym
-
         self.config = config
-        probe = gym.make(config.env)
-        obs_dim = int(np.prod(probe.observation_space.shape))
-        n_actions = int(probe.action_space.n)
-        probe.close()
-
-        self.module = DiscretePolicyModule(
-            DiscretePolicyConfig(obs_dim=obs_dim, n_actions=n_actions, hidden=config.hidden)
-        )
+        self.module = build_discrete_module(config.env, config.hidden)
         loss = functools.partial(
             impala_loss,
             gamma=config.gamma,
@@ -155,6 +145,9 @@ class IMPALA:
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
         self.iteration = 0
         self._updates_since_broadcast = 0
+        from collections import deque
+
+        self._recent_returns: "deque" = deque(maxlen=100)
         # Async pipeline: one in-flight sample request per runner.
         self._inflight: Dict[Any, Any] = {
             r.sample.remote(config.rollout_length): r
@@ -193,12 +186,20 @@ class IMPALA:
         self._updates_since_broadcast += 1
 
         if self._updates_since_broadcast >= cfg.broadcast_interval:
-            api.get(runner.set_weights.remote(api.put(self.learner_group.get_weights())))
+            # Push to the idle (just-consumed) runner only, and refresh the
+            # group's weight cache so replacement runners start current.
+            ref = api.put(self.learner_group.get_weights())
+            self.env_runner_group.cache_weights(ref)
+            api.get(runner.set_weights.remote(ref))
             self._updates_since_broadcast = 0
         # Re-issue sampling on the consumed runner.
         self._inflight[runner.sample.remote(cfg.rollout_length)] = runner
 
-        returns = self.env_runner_group.episode_returns()
+        # Episode returns ride the rollout payload — probing the runner
+        # actors here would queue behind their in-flight sample() calls and
+        # serialize the async pipeline.
+        self._recent_returns.extend(rollout.get("episode_returns", []))
+        returns = list(self._recent_returns)
         return {
             "iteration": self.iteration,
             "episode_return_mean": float(np.mean(returns)) if returns else float("nan"),

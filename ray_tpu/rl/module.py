@@ -102,3 +102,26 @@ def logp_entropy(logits: jax.Array, actions: jax.Array):
     p = jnp.exp(logp_all)
     entropy = -jnp.sum(p * logp_all, axis=-1)
     return logp, entropy
+
+
+def masked_mean(x: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
+    """Mean over valid (mask=1) entries; mask=None means all valid. Shared
+    by the PPO/IMPALA losses so masking semantics can't drift."""
+    if mask is None:
+        return jnp.mean(x)
+    return jnp.sum(x * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def build_discrete_module(env_name: str, hidden: Tuple[int, ...]) -> DiscretePolicyModule:
+    """Probes the env's spaces and builds the default discrete module
+    (shared by PPO/IMPALA constructors)."""
+    import gymnasium as gym
+    import numpy as np
+
+    probe = gym.make(env_name)
+    obs_dim = int(np.prod(probe.observation_space.shape))
+    n_actions = int(probe.action_space.n)
+    probe.close()
+    return DiscretePolicyModule(
+        DiscretePolicyConfig(obs_dim=obs_dim, n_actions=n_actions, hidden=tuple(hidden))
+    )

@@ -17,7 +17,7 @@ import numpy as np
 
 from .env_runner import EnvRunnerGroup
 from .learner import LearnerGroup
-from .module import DiscretePolicyConfig, DiscretePolicyModule, RLModule, logp_entropy
+from .module import RLModule, build_discrete_module, logp_entropy, masked_mean
 
 
 @dataclasses.dataclass
@@ -63,17 +63,27 @@ class PPOConfig:
         return PPO(self)
 
 
-def compute_gae(rewards, values, dones, last_values, gamma: float, lam: float):
+def compute_gae(
+    rewards, values, dones, last_values, gamma: float, lam: float, terminateds=None
+):
     """Generalized advantage estimation over [T, N] arrays (reference:
-    rllib/evaluation/postprocessing.py compute_gae_for_sample_batch)."""
+    rllib/evaluation/postprocessing.py compute_gae_for_sample_batch).
+
+    Truncation (time limit) bootstraps through the boundary: the delta uses
+    (1 - terminated) so V(final_obs) still backs up the truncated step,
+    while the recursion cuts at ANY episode end via (1 - done) — matching
+    the reference's truncation handling."""
+    if terminateds is None:
+        terminateds = dones
     T = rewards.shape[0]
     adv = np.zeros_like(rewards)
     last_gae = np.zeros_like(rewards[0])
     next_values = last_values
     for t in reversed(range(T)):
-        nonterminal = 1.0 - dones[t]
-        delta = rewards[t] + gamma * next_values * nonterminal - values[t]
-        last_gae = delta + gamma * lam * nonterminal * last_gae
+        bootstrap = 1.0 - terminateds[t]
+        boundary = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_values * bootstrap - values[t]
+        last_gae = delta + gamma * lam * boundary * last_gae
         adv[t] = last_gae
         next_values = values[t]
     returns = adv + values
@@ -87,25 +97,18 @@ def ppo_loss(module: RLModule, params, batch, *, clip: float, vf_coeff: float, e
     out = module.forward_train(params, batch["obs"])
     logp, entropy = logp_entropy(out["logits"], batch["actions"])
     mask = batch.get("mask")
-    if mask is None:
-        mask = jnp.ones_like(logp)
-    denom = jnp.maximum(jnp.sum(mask), 1.0)
-
-    def masked_mean(x):
-        return jnp.sum(x * mask) / denom
-
     ratio = jnp.exp(logp - batch["logp"])
     adv = batch["advantages"]
     surrogate = jnp.minimum(ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
-    policy_loss = -masked_mean(surrogate)
-    vf_loss = masked_mean((out["vf"] - batch["returns"]) ** 2)
-    ent = masked_mean(entropy)
+    policy_loss = -masked_mean(surrogate, mask)
+    vf_loss = masked_mean((out["vf"] - batch["returns"]) ** 2, mask)
+    ent = masked_mean(entropy, mask)
     total = policy_loss + vf_coeff * vf_loss - ent_coeff * ent
     return total, {
         "policy_loss": policy_loss,
         "vf_loss": vf_loss,
         "entropy": ent,
-        "kl_approx": masked_mean(batch["logp"] - logp),
+        "kl_approx": masked_mean(batch["logp"] - logp, mask),
     }
 
 
@@ -113,19 +116,10 @@ class PPO:
     """(reference: Algorithm + PPO.training_step, ppo.py:400)"""
 
     def __init__(self, config: PPOConfig):
-        import gymnasium as gym
-
-        self.config = config
-        probe = gym.make(config.env)
-        obs_dim = int(np.prod(probe.observation_space.shape))
-        n_actions = int(probe.action_space.n)
-        probe.close()
-
-        self.module = DiscretePolicyModule(
-            DiscretePolicyConfig(obs_dim=obs_dim, n_actions=n_actions, hidden=config.hidden)
-        )
         import functools
 
+        self.config = config
+        self.module = build_discrete_module(config.env, config.hidden)
         loss = functools.partial(
             ppo_loss,
             clip=config.clip_param,
@@ -164,7 +158,7 @@ class PPO:
         for ro in rollouts:
             adv, ret = compute_gae(
                 ro["rewards"], ro["values"], ro["dones"], ro["last_values"],
-                cfg.gamma, cfg.gae_lambda,
+                cfg.gamma, cfg.gae_lambda, terminateds=ro["terminateds"],
             )
             flat = {
                 "obs": ro["obs"].reshape(-1, ro["obs"].shape[-1]),

@@ -1,0 +1,13 @@
+"""ray_tpu.serve: model serving (re-design of the reference's Ray Serve,
+SURVEY.md §2f): controller/reconciler, p2c router, replicas, HTTP proxy,
+queue-depth autoscaling."""
+
+from .api import delete, get_app_handle, run, shutdown
+from .deployment import Application, AutoscalingConfig, Deployment, DeploymentConfig, deployment
+from .handle import DeploymentHandle, DeploymentResponse, start_proxy, stop_proxy
+
+__all__ = [
+    "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
+    "DeploymentHandle", "DeploymentResponse", "delete", "deployment",
+    "get_app_handle", "run", "shutdown", "start_proxy", "stop_proxy",
+]

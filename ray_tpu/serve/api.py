@@ -1,0 +1,68 @@
+"""serve.run / serve.delete / serve.shutdown — the user entrypoints
+(reference: python/ray/serve/api.py serve.run)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+from .. import api as core_api
+from .controller import get_or_create_controller
+from .deployment import Application, Deployment
+from .handle import DeploymentHandle, start_proxy, stop_proxy
+
+
+def run(
+    target: Union[Application, Deployment],
+    *,
+    name: str = "default",
+    blocking: bool = False,
+    http_port: Optional[int] = None,
+) -> DeploymentHandle:
+    """Deploys an application and returns its handle
+    (reference: serve/api.py serve.run)."""
+    import cloudpickle
+
+    if isinstance(target, Deployment):
+        target = target.bind()
+    if not isinstance(target, Application):
+        raise TypeError("serve.run expects a Deployment or bound Application")
+
+    if not core_api.is_initialized():
+        core_api.init(local_mode=True)
+    controller = get_or_create_controller()
+    dep = target.deployment
+    asc = dep.config.autoscaling_config
+    core_api.get(
+        controller.deploy.remote(
+            name,
+            cloudpickle.dumps(dep.func_or_class),
+            target.init_args,
+            target.init_kwargs,
+            dep.config.num_replicas,
+            dep.config.max_ongoing_requests,
+            asc.__dict__ if asc else None,
+            dep.config.ray_actor_options,
+        )
+    )
+    if http_port is not None:
+        start_proxy(http_port)
+    return DeploymentHandle(name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def delete(name: str = "default") -> None:
+    controller = get_or_create_controller()
+    core_api.get(controller.delete_app.remote(name))
+
+
+def shutdown() -> None:
+    stop_proxy()
+    try:
+        controller = core_api.get_actor("__serve_controller__")
+        core_api.get(controller.shutdown.remote())
+        core_api.kill(controller)
+    except Exception:
+        pass

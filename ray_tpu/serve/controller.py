@@ -1,0 +1,240 @@
+"""Serve controller + replica actors.
+
+Re-design of the reference's control plane (reference:
+python/ray/serve/_private/controller.py:84 ServeController actor;
+deployment_state.py:1245 DeploymentState reconciler; replica.py:828
+UserCallableWrapper; autoscaling_state.py + autoscaling_policy.py). The
+controller actor holds the desired state (apps -> deployments -> target
+replica count), reconciles actual replica actors toward it on a control
+loop, and serves the replica directory that handles long-poll against
+(version counter instead of the reference's LongPollHost).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import api
+
+CONTROLLER_NAME = "__serve_controller__"
+
+
+class Replica:
+    """Replica actor body wrapping the user callable (reference:
+    serve/_private/replica.py:828 UserCallableWrapper)."""
+
+    def __init__(self, cls_blob: bytes, init_args, init_kwargs):
+        import cloudpickle
+
+        target = cloudpickle.loads(cls_blob)
+        if isinstance(target, type):
+            self._callable = target(*init_args, **init_kwargs)
+        else:
+            self._callable = target
+        self._ongoing = 0
+        self._lock = threading.Lock()
+        self._total = 0
+
+    def handle_request(self, method: str, args, kwargs):
+        import asyncio
+        import inspect
+
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            fn = self._callable if method == "__call__" else getattr(self._callable, method)
+            if method == "__call__" and not callable(self._callable):
+                raise TypeError("deployment target is not callable")
+            out = fn(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                out = asyncio.run(out)
+            return out
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def queue_len(self) -> int:
+        return self._ongoing
+
+    def stats(self) -> Dict[str, int]:
+        return {"ongoing": self._ongoing, "total": self._total}
+
+    def health_check(self) -> bool:
+        return True
+
+
+class ServeController:
+    """Named controller actor (reference: controller.py:84)."""
+
+    def __init__(self):
+        self._apps: Dict[str, Dict[str, Any]] = {}  # app -> spec
+        self._replicas: Dict[str, List[Any]] = {}  # app -> replica handles
+        self._version = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._loop = threading.Thread(target=self._control_loop, daemon=True)
+        self._loop.start()
+        self._last_scale_action: Dict[str, float] = {}
+
+    # ------------------------------------------------------------- deploy
+    def deploy(
+        self,
+        app_name: str,
+        cls_blob: bytes,
+        init_args,
+        init_kwargs,
+        num_replicas: int,
+        max_ongoing: int,
+        autoscaling: Optional[dict],
+        actor_options: Dict[str, Any],
+    ) -> bool:
+        with self._lock:
+            redeploy = app_name in self._apps
+            old_replicas = self._replicas.get(app_name, []) if redeploy else []
+            self._apps[app_name] = {
+                "cls_blob": cls_blob,
+                "init_args": init_args,
+                "init_kwargs": init_kwargs,
+                "target_replicas": num_replicas,
+                "max_ongoing": max_ongoing,
+                "autoscaling": autoscaling,
+                "actor_options": actor_options,
+            }
+            # Redeploy replaces the code: existing replicas run the OLD
+            # blob and must be torn down so the reconciler rebuilds them
+            # (reference: deployment_state version-change rollout).
+            self._replicas[app_name] = []
+            self._version += 1
+        for r in old_replicas:
+            try:
+                api.kill(r)
+            except Exception:
+                pass
+        self._reconcile()
+        return True
+
+    def delete_app(self, app_name: str) -> bool:
+        with self._lock:
+            self._apps.pop(app_name, None)
+            replicas = self._replicas.pop(app_name, [])
+            self._version += 1
+        for r in replicas:
+            try:
+                api.kill(r)
+            except Exception:
+                pass
+        return True
+
+    # ---------------------------------------------------------- reconcile
+    def _reconcile(self) -> None:
+        """Drives actual replica sets toward targets (reference:
+        deployment_state.py DeploymentState.update)."""
+        with self._lock:
+            apps = dict(self._apps)
+        for name, spec in apps.items():
+            current = self._replicas.get(name, [])
+            target = spec["target_replicas"]
+            opts = {"max_concurrency": spec["max_ongoing"], **spec["actor_options"]}
+            replica_cls = api.remote(**opts)(Replica)
+            changed = False
+            while len(current) < target:
+                current.append(
+                    replica_cls.remote(spec["cls_blob"], spec["init_args"], spec["init_kwargs"])
+                )
+                changed = True
+            while len(current) > target:
+                victim = current.pop()
+                changed = True
+                try:
+                    api.kill(victim)
+                except Exception:
+                    pass
+            with self._lock:
+                self._replicas[name] = current
+                if changed:
+                    self._version += 1
+
+    def _control_loop(self) -> None:
+        while not self._stop.wait(0.25):
+            try:
+                self._autoscale()
+                self._reconcile()
+            except Exception:
+                pass
+
+    # ---------------------------------------------------------- autoscale
+    def _autoscale(self) -> None:
+        """Queue-depth autoscaling (reference: serve/autoscaling_policy.py
+        replica-queue-length policy)."""
+        now = time.monotonic()
+        with self._lock:
+            apps = dict(self._apps)
+        for name, spec in apps.items():
+            asc = spec.get("autoscaling")
+            if not asc:
+                continue
+            replicas = self._replicas.get(name, [])
+            if not replicas:
+                continue
+            try:
+                loads = api.get([r.queue_len.remote() for r in replicas], timeout=2)
+            except Exception:
+                continue
+            total = sum(loads)
+            per = total / max(1, len(replicas))
+            target = spec["target_replicas"]
+            new_target = target
+            if per > asc["target_ongoing_requests"] and target < asc["max_replicas"]:
+                if now - self._last_scale_action.get(name, 0) >= asc["upscale_delay_s"]:
+                    new_target = min(asc["max_replicas"], target + 1)
+            elif per < asc["target_ongoing_requests"] / 2 and target > asc["min_replicas"]:
+                if now - self._last_scale_action.get(name, 0) >= asc["downscale_delay_s"]:
+                    new_target = max(asc["min_replicas"], target - 1)
+            if new_target != target:
+                self._last_scale_action[name] = now
+                with self._lock:
+                    if name in self._apps:
+                        self._apps[name]["target_replicas"] = new_target
+
+    # ------------------------------------------------------------ queries
+    def get_replicas(self, app_name: str) -> Tuple[int, List[Any]]:
+        """Returns (version, replica handles) — the handle long-polls by
+        comparing versions (reference: long_poll.py LongPollHost)."""
+        with self._lock:
+            return self._version, list(self._replicas.get(app_name, []))
+
+    def list_apps(self) -> List[str]:
+        with self._lock:
+            return list(self._apps)
+
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def num_replicas(self, app_name: str) -> int:
+        with self._lock:
+            return len(self._replicas.get(app_name, []))
+
+    def shutdown(self) -> bool:
+        self._stop.set()
+        for name in list(self._replicas):
+            self.delete_app(name)
+        return True
+
+
+def get_or_create_controller():
+    try:
+        return api.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        pass
+    controller_cls = api.remote(max_concurrency=16, name=CONTROLLER_NAME, lifetime="detached")(
+        ServeController
+    )
+    try:
+        return controller_cls.remote()
+    except ValueError:
+        # lost the naming race
+        return api.get_actor(CONTROLLER_NAME)

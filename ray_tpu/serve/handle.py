@@ -1,0 +1,197 @@
+"""DeploymentHandle + power-of-two-choices routing + HTTP proxy.
+
+Re-design of the reference's request path (reference:
+python/ray/serve/handle.py:625 DeploymentHandle.remote;
+router.py:559 AsyncioRouter.assign_request;
+replica_scheduler/pow_2_scheduler.py:52 PowerOfTwoChoicesReplicaScheduler,
+choose_replica_for_request :813; proxy.py:779 HTTPProxy). The handle
+keeps client-side outstanding counters per replica and picks the less
+loaded of two random candidates — the same O(1) balancing argument as the
+reference's queue-length-probe scheduler without the probe RPC.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+from .. import api
+from .controller import CONTROLLER_NAME
+
+
+class DeploymentResponse:
+    """Future-like response (reference: serve/handle.py DeploymentResponse)."""
+
+    def __init__(self, ref, on_done):
+        self._ref = ref
+        self._on_done = on_done
+        self._done = False
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        try:
+            out = api.get(self._ref, timeout=timeout)
+        finally:
+            if not self._done:
+                self._done = True
+                self._on_done()
+        return out
+
+
+class DeploymentHandle:
+    """(reference: serve/handle.py:625)"""
+
+    def __init__(self, app_name: str, method_name: str = "__call__"):
+        self._app = app_name
+        self._method = method_name
+        self._controller = api.get_actor(CONTROLLER_NAME)
+        self._version = -1
+        self._replicas: List[Any] = []
+        self._outstanding: Dict[Any, int] = {}
+        self._lock = threading.Lock()
+        self._refresh()
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        h = DeploymentHandle.__new__(DeploymentHandle)
+        h.__dict__.update(self.__dict__)
+        h._method = method_name
+        return h
+
+    def _refresh(self, force: bool = False) -> None:
+        version = api.get(self._controller.version.remote())
+        if version == self._version and not force and self._replicas:
+            return
+        self._version, self._replicas = api.get(
+            self._controller.get_replicas.remote(self._app)
+        )
+        with self._lock:
+            self._outstanding = {r._id: self._outstanding.get(r._id, 0) for r in self._replicas}
+
+    def _choose_replica(self):
+        """Power of two choices over client-side outstanding counts
+        (reference: pow_2_scheduler.py:813)."""
+        self._refresh()
+        if not self._replicas:
+            raise RuntimeError(f"no replicas for app {self._app!r}")
+        if len(self._replicas) == 1:
+            return self._replicas[0]
+        a, b = random.sample(self._replicas, 2)
+        with self._lock:
+            return a if self._outstanding.get(a._id, 0) <= self._outstanding.get(b._id, 0) else b
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        replica = self._choose_replica()
+        rid = replica._id
+        with self._lock:
+            self._outstanding[rid] = self._outstanding.get(rid, 0) + 1
+
+        def done():
+            with self._lock:
+                if rid in self._outstanding:
+                    self._outstanding[rid] -= 1
+
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+        return DeploymentResponse(ref, done)
+
+
+# ------------------------------------------------------------------ proxy
+
+
+class _ProxyServer:
+    """Minimal threaded HTTP/1.1 proxy (reference: proxy.py:1153
+    ProxyActor + HTTPProxy ASGI app at :779; here a stdlib server because
+    the data plane is JSON-over-HTTP round trips to replica actors)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        import http.server
+        import socketserver
+
+        proxy = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _dispatch(self, body: Optional[bytes]):
+                path = self.path.strip("/").split("?")[0]
+                app = path.split("/")[0] if path else ""
+                try:
+                    handle = proxy._handle_for(app)
+                except Exception as e:
+                    self._send(404, {"error": f"no app {app!r}: {e}"})
+                    return
+                try:
+                    payload = json.loads(body) if body else None
+                except json.JSONDecodeError:
+                    payload = body.decode()
+                try:
+                    if payload is None:
+                        out = handle.remote().result(timeout=30)
+                    else:
+                        out = handle.remote(payload).result(timeout=30)
+                    self._send(200, out)
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": repr(e)})
+
+            def _send(self, code: int, payload: Any):
+                data = json.dumps(payload, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch(None)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self._dispatch(self.rfile.read(n) if n else None)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def _handle_for(self, app: str) -> DeploymentHandle:
+        if app not in self._handles:
+            controller = api.get_actor(CONTROLLER_NAME)
+            apps = api.get(controller.list_apps.remote())
+            if app not in apps:
+                if app == "" and len(apps) == 1:
+                    app_real = apps[0]
+                    self._handles[""] = DeploymentHandle(app_real)
+                    return self._handles[""]
+                raise KeyError(app)
+            self._handles[app] = DeploymentHandle(app)
+        return self._handles[app]
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+_proxy: Optional[_ProxyServer] = None
+
+
+def start_proxy(port: int = 0) -> int:
+    """Starts (or returns) the node's HTTP proxy; returns the bound port."""
+    global _proxy
+    if _proxy is None:
+        _proxy = _ProxyServer(port=port)
+    return _proxy.port
+
+
+def stop_proxy() -> None:
+    global _proxy
+    if _proxy is not None:
+        _proxy.shutdown()
+        _proxy = None
