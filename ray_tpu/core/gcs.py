@@ -1,0 +1,390 @@
+"""GCS: the cluster control plane (head-node daemon).
+
+Re-design of the reference's GCS server (reference:
+src/ray/gcs/gcs_server/gcs_server.h:80; node manager gcs_node_manager.h:45;
+actor registry + restart FT gcs_actor_manager.h:308/:548; actor placement
+gcs_actor_scheduler.h:111; placement groups gcs_placement_group_manager.h:230;
+internal KV gcs_kv_manager.h; health checks gcs_health_check_manager.h;
+object directory ownership_based_object_directory.h — centralized here
+because the simulated cluster has no per-owner metadata service yet).
+
+Runs as its own process serving RPC over a UDS. Like the reference, the
+GCS is NOT on the task fast path: drivers talk to raylets for tasks and
+objects; the GCS holds membership, actors, PGs, the object directory and
+the resource view used for spillback decisions.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+HEARTBEAT_TIMEOUT_S = 5.0
+
+
+class GcsService:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, dict] = {}
+        self._actors: Dict[str, dict] = {}
+        self._named: Dict[Tuple[str, str], str] = {}
+        self._objects: Dict[str, Set[str]] = {}
+        self._kv: Dict[str, bytes] = {}
+        self._pgs: Dict[str, dict] = {}
+        self._stop = threading.Event()
+        self._health = threading.Thread(target=self._health_loop, daemon=True)
+        self._health.start()
+
+    # ------------------------------------------------------------- nodes
+    def register_node(self, node_id: str, sock_path: str, store_path: str, resources: dict) -> bool:
+        with self._lock:
+            self._nodes[node_id] = {
+                "sock": sock_path,
+                "store": store_path,
+                "resources": dict(resources),
+                "available": dict(resources),
+                "alive": True,
+                "last_hb": time.monotonic(),
+            }
+        return True
+
+    def heartbeat(self, node_id: str, available: dict) -> bool:
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n is None:
+                return False
+            n["available"] = dict(available)
+            n["last_hb"] = time.monotonic()
+            n["alive"] = True
+        return True
+
+    def drain_node(self, node_id: str) -> bool:
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n:
+                n["alive"] = False
+        self._on_node_death(node_id)
+        return True
+
+    def list_nodes(self) -> List[dict]:
+        with self._lock:
+            return [
+                {"NodeID": nid, "Alive": n["alive"], "Resources": dict(n["resources"]),
+                 "sock": n["sock"], "store": n["store"]}
+                for nid, n in self._nodes.items()
+            ]
+
+    def node_info(self, node_id: str) -> Optional[dict]:
+        with self._lock:
+            n = self._nodes.get(node_id)
+            return dict(n) if n else None
+
+    def cluster_resources(self) -> Dict[str, float]:
+        with self._lock:
+            out: Dict[str, float] = {}
+            for n in self._nodes.values():
+                if not n["alive"]:
+                    continue
+                for k, v in n["resources"].items():
+                    out[k] = out.get(k, 0.0) + v
+            return out
+
+    def available_resources(self) -> Dict[str, float]:
+        with self._lock:
+            out: Dict[str, float] = {}
+            for n in self._nodes.values():
+                if not n["alive"]:
+                    continue
+                for k, v in n["available"].items():
+                    out[k] = out.get(k, 0.0) + v
+            return out
+
+    # ------------------------------------------------- scheduling assist
+    def pick_node(self, resources: dict, exclude: Optional[List[str]] = None) -> Optional[dict]:
+        """Best-fit node for a resource request (the cluster-level half of
+        the two-level scheduler; reference: cluster_resource_scheduler.h:44
+        + hybrid policy). Packs onto the most-utilized feasible node."""
+        exclude = set(exclude or [])
+        best = None
+        best_score = -1.0
+        with self._lock:
+            for nid, n in self._nodes.items():
+                if nid in exclude or not n["alive"]:
+                    continue
+                avail = n["available"]
+                if all(avail.get(k, 0.0) >= v for k, v in resources.items()):
+                    total = sum(n["resources"].values()) or 1.0
+                    used = 1.0 - sum(avail.values()) / total
+                    if used > best_score:
+                        best_score = used
+                        best = {"node_id": nid, "sock": n["sock"], "store": n["store"]}
+        return best
+
+    def _health_loop(self):
+        while not self._stop.wait(1.0):
+            dead = []
+            with self._lock:
+                for nid, n in self._nodes.items():
+                    if n["alive"] and time.monotonic() - n["last_hb"] > HEARTBEAT_TIMEOUT_S:
+                        n["alive"] = False
+                        dead.append(nid)
+            for nid in dead:
+                self._on_node_death(nid)
+
+    def _on_node_death(self, node_id: str) -> None:
+        """Node failure: objects there are lost from the directory; actors
+        become restart candidates (reference: gcs_node_manager death
+        handling -> gcs_actor_manager restart :548)."""
+        with self._lock:
+            for locs in self._objects.values():
+                locs.discard(node_id)
+            for aid, a in self._actors.items():
+                if a.get("node_id") == node_id and a["state"] in ("ALIVE", "PENDING"):
+                    a["state"] = "RESTARTING" if self._can_restart(a) else "DEAD"
+                    a["node_id"] = None
+                    if a["state"] == "DEAD":
+                        a["death_reason"] = f"node {node_id[:8]} died"
+                        self._drop_name(aid)
+
+    # ------------------------------------------------------------- actors
+    @staticmethod
+    def _can_restart(a: dict) -> bool:
+        mr = a.get("max_restarts", 0)
+        return mr == -1 or a.get("num_restarts", 0) < mr
+
+    def _drop_name(self, actor_id: str) -> None:
+        a = self._actors.get(actor_id, {})
+        key = (a.get("namespace") or "default", a.get("name") or "")
+        if a.get("name") and self._named.get(key) == actor_id:
+            del self._named[key]
+
+    def register_actor(
+        self,
+        actor_id: str,
+        spec_blob: bytes,
+        resources: dict,
+        max_restarts: int,
+        name: Optional[str],
+        namespace: Optional[str],
+    ) -> dict:
+        """Registers + places an actor; returns the chosen node (the caller
+        raylet/driver forwards the creation there). Reference:
+        gcs_actor_manager.h RegisterActor + gcs_actor_scheduler placement."""
+        with self._lock:
+            if name:
+                key = (namespace or "default", name)
+                if key in self._named:
+                    raise ValueError(f"actor name {name!r} already taken")
+            node = None
+        node = self.pick_node(resources)
+        with self._lock:
+            if node is None:
+                raise RuntimeError(f"no node can host actor requiring {resources}")
+            self._actors[actor_id] = {
+                "state": "PENDING",
+                "node_id": node["node_id"],
+                "spec_blob": spec_blob,
+                "resources": dict(resources),
+                "max_restarts": max_restarts,
+                "num_restarts": 0,
+                "name": name,
+                "namespace": namespace or "default",
+                "death_reason": "",
+            }
+            if name:
+                self._named[(namespace or "default", name)] = actor_id
+        return node
+
+    def actor_started(self, actor_id: str, node_id: str) -> bool:
+        with self._lock:
+            a = self._actors.get(actor_id)
+            if a:
+                a["state"] = "ALIVE"
+                a["node_id"] = node_id
+        return True
+
+    def actor_died(self, actor_id: str, reason: str, no_restart: bool = False) -> dict:
+        """Returns the restart decision: {restart: bool, node: info}
+        (reference: actor state machine, design_docs/actor_states.rst)."""
+        with self._lock:
+            a = self._actors.get(actor_id)
+            if a is None:
+                return {"restart": False}
+            if no_restart or not self._can_restart(a):
+                a["state"] = "DEAD"
+                a["death_reason"] = reason
+                a["node_id"] = None
+                self._drop_name(actor_id)
+                return {"restart": False}
+            a["num_restarts"] += 1
+            a["state"] = "RESTARTING"
+            resources = dict(a["resources"])
+        node = self.pick_node(resources)
+        with self._lock:
+            a = self._actors[actor_id]
+            if node is None:
+                a["state"] = "DEAD"
+                a["death_reason"] = f"{reason}; no node for restart"
+                self._drop_name(actor_id)
+                return {"restart": False}
+            a["node_id"] = node["node_id"]
+            return {"restart": True, "node": node, "spec_blob": a["spec_blob"],
+                    "num_restarts": a["num_restarts"]}
+
+    def get_actor(self, actor_id: str) -> Optional[dict]:
+        with self._lock:
+            a = self._actors.get(actor_id)
+            if a is None:
+                return None
+            out = {k: v for k, v in a.items() if k != "spec_blob"}
+            node = self._nodes.get(a["node_id"]) if a["node_id"] else None
+            out["sock"] = node["sock"] if node else None
+            return out
+
+    def lookup_named_actor(self, name: str, namespace: Optional[str]) -> Optional[str]:
+        with self._lock:
+            return self._named.get((namespace or "default", name))
+
+    # ------------------------------------------------------------ objects
+    def add_object_location(self, oid_hex: str, node_id: str) -> bool:
+        with self._lock:
+            self._objects.setdefault(oid_hex, set()).add(node_id)
+        return True
+
+    def get_object_locations(self, oid_hex: str) -> List[dict]:
+        with self._lock:
+            locs = self._objects.get(oid_hex, set())
+            return [
+                {"node_id": nid, "sock": self._nodes[nid]["sock"], "store": self._nodes[nid]["store"]}
+                for nid in locs
+                if nid in self._nodes and self._nodes[nid]["alive"]
+            ]
+
+    # --------------------------------------------------------------- kv
+    def kv_put(self, key: str, value: bytes) -> bool:
+        with self._lock:
+            self._kv[key] = value
+        return True
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get(key)
+
+    def kv_del(self, key: str) -> bool:
+        with self._lock:
+            return self._kv.pop(key, None) is not None
+
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        with self._lock:
+            return [k for k in self._kv if k.startswith(prefix)]
+
+    # ------------------------------------------------------ placement grp
+    def create_placement_group(self, pg_id: str, bundles: List[dict], strategy: str) -> dict:
+        """Places bundles per policy (reference: bundle_scheduling_policy.h
+        PACK/SPREAD/STRICT_PACK/STRICT_SPREAD + the TPU-native SLICE_GANG).
+        Returns {placements: [node_id per bundle]} or raises."""
+        placements: List[str] = []
+        with self._lock:
+            avail = {
+                nid: dict(n["available"]) for nid, n in self._nodes.items() if n["alive"]
+            }
+        order = sorted(avail, key=lambda nid: -sum(avail[nid].values()))
+
+        def fits(nid, b):
+            return all(avail[nid].get(k, 0.0) >= v for k, v in b.items())
+
+        def take(nid, b):
+            for k, v in b.items():
+                avail[nid][k] = avail[nid].get(k, 0.0) - v
+
+        for i, bundle in enumerate(bundles):
+            chosen = None
+            if strategy in ("PACK", "STRICT_PACK"):
+                pool = placements[:1] if (strategy == "STRICT_PACK" and placements) else order
+                for nid in pool if placements else order:
+                    if fits(nid, bundle):
+                        chosen = nid
+                        break
+                if chosen is None and strategy == "PACK":
+                    for nid in order:
+                        if fits(nid, bundle):
+                            chosen = nid
+                            break
+            elif strategy in ("SPREAD", "STRICT_SPREAD", "SLICE_GANG"):
+                used = set(placements)
+                candidates = [n for n in order if n not in used] or (
+                    order if strategy == "SPREAD" else []
+                )
+                for nid in candidates:
+                    if fits(nid, bundle):
+                        chosen = nid
+                        break
+            if chosen is None:
+                raise RuntimeError(
+                    f"cannot place bundle {i} ({bundle}) with strategy {strategy}"
+                )
+            take(chosen, bundle)
+            placements.append(chosen)
+
+        with self._lock:
+            # SLICE_GANG: atomic lease — resources deducted together so the
+            # whole gang either fits or the creation fails (replaces the
+            # TPU-{pod}-head idiom, reference: accelerators/tpu.py:334-397).
+            for nid, bundle in zip(placements, bundles):
+                n = self._nodes.get(nid)
+                if n:
+                    for k, v in bundle.items():
+                        n["available"][k] = n["available"].get(k, 0.0) - v
+            self._pgs[pg_id] = {
+                "bundles": bundles,
+                "strategy": strategy,
+                "placements": placements,
+                "state": "CREATED",
+            }
+        return {"placements": placements}
+
+    def remove_placement_group(self, pg_id: str) -> bool:
+        with self._lock:
+            pg = self._pgs.pop(pg_id, None)
+            if pg:
+                for nid, bundle in zip(pg["placements"], pg["bundles"]):
+                    n = self._nodes.get(nid)
+                    if n:
+                        for k, v in bundle.items():
+                            n["available"][k] = n["available"].get(k, 0.0) + v
+        return True
+
+    def placement_group_table(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._pgs.items()}
+
+    def get_placement_group(self, pg_id: str) -> Optional[dict]:
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            return dict(pg) if pg else None
+
+    # ----------------------------------------------------------- control
+    def ping(self) -> str:
+        return "pong"
+
+    def stop(self) -> bool:
+        self._stop.set()
+        return True
+
+
+def main(sock_path: str) -> None:
+    from .rpc import RpcServer
+
+    service = GcsService()
+    server = RpcServer(sock_path, service)
+    try:
+        while not service._stop.wait(0.5):
+            pass
+    finally:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

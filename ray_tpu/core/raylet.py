@@ -1,0 +1,517 @@
+"""Raylet: per-node daemon — worker pool, local scheduler, object plane.
+
+Re-design of the reference's raylet (reference: src/ray/raylet/
+node_manager.h:119 NodeManager; worker_pool.h:174 WorkerPool/PopWorker;
+scheduling/cluster_task_manager.cc:44 QueueAndScheduleTask with spillback;
+local_task_manager.cc:74 dispatch; dependency_manager.h). One raylet per
+simulated node; each owns a shared-memory store segment and a pool of
+worker processes that long-poll it for tasks.
+
+Scheduling is two-level like the reference: the raylet first decides
+local-vs-remote (consulting the GCS resource view; a remote choice
+FORWARDS the task to that raylet — the analogue of lease spillback), then
+the local half gates dispatch on resource availability and argument
+locality (missing args are pulled from their location per the GCS object
+directory before dispatch)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import queue
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .ids import ObjectID
+from .object_transport import StoredError
+from .rpc import RpcClient, RpcServer
+from .shm_store import SharedMemoryStore
+
+POLL_TIMEOUT_S = 30.0
+
+
+class _Worker:
+    def __init__(self, worker_id: str, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.mailbox: "queue.Queue" = queue.Queue()
+        self.busy_with: Optional[dict] = None  # task entry being executed
+        self.actor_id: Optional[str] = None  # dedicated actor worker
+
+
+class RayletService:
+    def __init__(
+        self,
+        node_id: str,
+        sock_path: str,
+        store_path: str,
+        gcs_sock: str,
+        resources: Dict[str, float],
+        store_capacity: int,
+    ):
+        self.node_id = node_id
+        self.sock_path = sock_path
+        self.store_path = store_path
+        self.store = SharedMemoryStore.create(store_path, store_capacity)
+        self.gcs = RpcClient(gcs_sock)
+        self.gcs_sock = gcs_sock
+        self.total = dict(resources)
+        self.available = dict(resources)
+        self._res_lock = threading.Lock()
+
+        self._workers: Dict[str, _Worker] = {}
+        self._idle: List[str] = []
+        self._workers_lock = threading.Lock()
+        self._max_task_workers = max(1, int(resources.get("CPU", 1)))
+
+        self._pending: "queue.Queue" = queue.Queue()  # task entries
+        self._waiting: List[dict] = []  # dep-blocked entries
+        self._actors: Dict[str, dict] = {}  # actor_id -> {worker_id, queue, state}
+        self._actor_lock = threading.Lock()
+
+        self._remote_raylets: Dict[str, RpcClient] = {}
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._scheduler_loop, daemon=True, name="sched"),
+            threading.Thread(target=self._heartbeat_loop, daemon=True, name="hb"),
+            threading.Thread(target=self._monitor_loop, daemon=True, name="monitor"),
+        ]
+        self.gcs.call(
+            "register_node", node_id, sock_path, store_path, resources
+        )
+        for t in self._threads:
+            t.start()
+
+    # ------------------------------------------------------------ helpers
+    def _remote(self, sock: str) -> RpcClient:
+        cli = self._remote_raylets.get(sock)
+        if cli is None:
+            cli = RpcClient(sock)
+            self._remote_raylets[sock] = cli
+        return cli
+
+    def _try_acquire(self, resources: Dict[str, float]) -> bool:
+        with self._res_lock:
+            if all(self.available.get(k, 0.0) >= v for k, v in resources.items()):
+                for k, v in resources.items():
+                    self.available[k] = self.available.get(k, 0.0) - v
+                return True
+            return False
+
+    def _release(self, resources: Dict[str, float]) -> None:
+        with self._res_lock:
+            for k, v in resources.items():
+                self.available[k] = min(self.total.get(k, 0.0), self.available.get(k, 0.0) + v)
+
+    def _fits_total(self, resources: Dict[str, float]) -> bool:
+        return all(self.total.get(k, 0.0) >= v for k, v in resources.items())
+
+    # ----------------------------------------------------------- ingress
+    def submit_task(self, spec_blob: bytes, forwarded: bool = False) -> List[bytes]:
+        """Queues a normal task; returns return-object ids. May forward to
+        another node (spillback, reference: cluster_task_manager.cc:136)."""
+        entry = pickle.loads(spec_blob)
+        resources = entry["resources"]
+        if not forwarded:
+            # Cluster-level decision: if it can't run here (ever, or not
+            # soon) and another node has room now, forward it.
+            if not self._fits_total(resources):
+                # The GCS resource view lags by one heartbeat; a busy-now
+                # node may free up, so retry placement before failing.
+                deadline = time.monotonic() + 10.0
+                target = None
+                while target is None:
+                    target = self.gcs.call("pick_node", resources, [self.node_id])
+                    if target is not None:
+                        break
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(f"no node can satisfy {resources}")
+                    time.sleep(0.1)
+                return self._remote(target["sock"]).call("submit_task", spec_blob, True)
+            if not self._can_run_soon(resources):
+                target = self.gcs.call("pick_node", resources, [self.node_id])
+                if target is not None:
+                    return self._remote(target["sock"]).call("submit_task", spec_blob, True)
+        entry["type"] = "task"
+        self._pending.put(entry)
+        return entry["return_ids"]
+
+    def _can_run_soon(self, resources) -> bool:
+        with self._res_lock:
+            return all(self.available.get(k, 0.0) >= v for k, v in resources.items())
+
+    def create_actor(self, spec_blob: bytes, forwarded: bool = False) -> bool:
+        """Hosts an actor (the GCS already picked this node)."""
+        entry = pickle.loads(spec_blob)
+        entry["type"] = "actor_creation"
+        with self._actor_lock:
+            self._actors[entry["actor_id"]] = {
+                "worker_id": None,
+                "state": "PENDING",
+                "inflight": [],  # dispatched actor tasks, FIFO (serial exec)
+                "spec_blob": spec_blob,
+                "resources": entry["resources"],
+                "resources_held": False,
+            }
+        self._pending.put(entry)
+        return True
+
+    def submit_actor_task(self, spec_blob: bytes) -> List[bytes]:
+        entry = pickle.loads(spec_blob)
+        entry["type"] = "actor_task"
+        aid = entry["actor_id"]
+        with self._actor_lock:
+            a = self._actors.get(aid)
+            if a is None or a["state"] == "DEAD":
+                self._store_error_for(
+                    entry,
+                    RuntimeError(
+                        f"actor {aid[:8]} is not on this node or is dead"
+                    ),
+                )
+                return entry["return_ids"]
+        self._pending.put(entry)
+        return entry["return_ids"]
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> bool:
+        with self._actor_lock:
+            a = self._actors.get(actor_id)
+            wid = a.get("worker_id") if a else None
+            if a:
+                a["state"] = "DEAD"
+        self.gcs.call("actor_died", actor_id, "killed via kill()", no_restart)
+        if wid:
+            with self._workers_lock:
+                w = self._workers.get(wid)
+            if w:
+                w.proc.kill()
+        return True
+
+    # ------------------------------------------------------- object plane
+    def pull_object(self, oid_hex: str, timeout: float = 30.0) -> bool:
+        """Ensures the object is in the local store, fetching from a remote
+        node if needed (reference: pull_manager.h:52)."""
+        oid = ObjectID.from_hex(oid_hex)
+        if self.store.contains(oid):
+            return True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            locations = self.gcs.call("get_object_locations", oid_hex)
+            for loc in locations:
+                if loc["node_id"] == self.node_id:
+                    continue
+                try:
+                    raw = self._remote(loc["sock"]).call("fetch_object", oid_hex)
+                except Exception:
+                    continue
+                if raw is not None:
+                    self.store.put_raw(oid, raw)
+                    self.gcs.call("add_object_location", oid_hex, self.node_id)
+                    return True
+            if self.store.contains(oid):
+                return True
+            time.sleep(0.01)
+        return False
+
+    def fetch_object(self, oid_hex: str) -> Optional[bytes]:
+        """Serves the framed payload to a pulling raylet (the push half of
+        the reference's object-manager transfer, push_manager.h:30)."""
+        return self.store.get_raw(ObjectID.from_hex(oid_hex))
+
+    def notify_object(self, oid_hex: str) -> bool:
+        self.gcs.call("add_object_location", oid_hex, self.node_id)
+        return True
+
+    # ----------------------------------------------------- worker service
+    def worker_poll(self, worker_id: str) -> dict:
+        """Long-poll: the worker's task mailbox (reference: the PushTask
+        direction is inverted — workers pull — which removes per-worker
+        server sockets)."""
+        with self._workers_lock:
+            w = self._workers.get(worker_id)
+        if w is None:
+            return {"type": "stop"}
+        try:
+            return w.mailbox.get(timeout=POLL_TIMEOUT_S)
+        except queue.Empty:
+            return {"type": "noop"}
+
+    def worker_done(self, worker_id: str, ok: bool) -> bool:
+        with self._workers_lock:
+            w = self._workers.get(worker_id)
+            if w is None:
+                return False
+            entry = w.busy_with
+            w.busy_with = None
+            if w.actor_id is None:
+                self._idle.append(worker_id)
+        if w.actor_id is not None and entry is None:
+            # Serial actor execution: the completed task is the oldest
+            # in-flight entry.
+            with self._actor_lock:
+                a = self._actors.get(w.actor_id)
+                if a and a["inflight"]:
+                    a["inflight"].pop(0)
+        if entry is not None:
+            if entry["type"] == "task":
+                self._release(entry["resources"])
+            elif entry["type"] == "actor_creation":
+                aid = entry["actor_id"]
+                if ok:
+                    with self._actor_lock:
+                        a = self._actors.get(aid)
+                        if a:
+                            a["state"] = "ALIVE"
+                    self.gcs.call("actor_started", aid, self.node_id)
+                else:
+                    with self._actor_lock:
+                        a = self._actors.get(aid)
+                        if a:
+                            a["state"] = "DEAD"
+                    self.gcs.call("actor_died", aid, "constructor failed", True)
+        return True
+
+    # --------------------------------------------------------- scheduling
+    def _scheduler_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                entry = self._pending.get(timeout=0.05)
+            except queue.Empty:
+                entry = None
+            if entry is not None:
+                self._waiting.append(entry)
+            # Try to dispatch every waiting entry whose deps + resources are
+            # ready (reference: local_task_manager.cc dispatch loop).
+            still: List[dict] = []
+            for e in self._waiting:
+                if not self._deps_ready(e):
+                    still.append(e)
+                    continue
+                if not self._dispatch(e):
+                    still.append(e)
+            self._waiting = still
+
+    def _deps_ready(self, entry: dict) -> bool:
+        for dep_hex in entry.get("deps", []):
+            oid = ObjectID.from_hex(dep_hex)
+            if not self.store.contains(oid):
+                # Kick off a pull; non-blocking check next round.
+                threading.Thread(
+                    target=self.pull_object, args=(dep_hex,), daemon=True
+                ).start()
+                return False
+        return True
+
+    def _dispatch(self, entry: dict) -> bool:
+        kind = entry["type"]
+        if kind == "task":
+            if not self._try_acquire(entry["resources"]):
+                return False
+            w = self._checkout_worker()
+            if w is None:
+                self._release(entry["resources"])
+                return False
+            w.busy_with = entry
+            w.mailbox.put({"type": "task", "entry": entry})
+            return True
+        if kind == "actor_creation":
+            if not self._try_acquire(entry["resources"]):
+                return False
+            w = self._spawn_worker(actor_id=entry["actor_id"])
+            with self._actor_lock:
+                a = self._actors.get(entry["actor_id"])
+                if a is not None:
+                    a["worker_id"] = w.worker_id
+                    a["resources_held"] = True
+            w.busy_with = entry
+            w.mailbox.put({"type": "task", "entry": entry})
+            return True
+        if kind == "actor_task":
+            aid = entry["actor_id"]
+            with self._actor_lock:
+                a = self._actors.get(aid)
+                if a is None or a["state"] == "DEAD":
+                    self._store_error_for(entry, RuntimeError(f"actor {aid[:8]} dead"))
+                    return True
+                wid = a.get("worker_id")
+            if wid is None:
+                return False  # still constructing
+            with self._workers_lock:
+                w = self._workers.get(wid)
+            if w is None:
+                return False
+            # Actor mailbox preserves submission order; the worker executes
+            # serially (reference: actor_scheduling_queue.h ordered queue).
+            with self._actor_lock:
+                a["inflight"].append(entry)
+            w.mailbox.put({"type": "task", "entry": entry})
+            return True
+        return True
+
+    def _checkout_worker(self) -> Optional[_Worker]:
+        with self._workers_lock:
+            while self._idle:
+                wid = self._idle.pop()
+                w = self._workers.get(wid)
+                if w is not None and w.proc.poll() is None:
+                    return w
+            n_task_workers = sum(1 for w in self._workers.values() if w.actor_id is None)
+            if n_task_workers < self._max_task_workers:
+                return self._spawn_worker_locked()
+        return None
+
+    def _spawn_worker(self, actor_id: Optional[str] = None) -> _Worker:
+        with self._workers_lock:
+            return self._spawn_worker_locked(actor_id)
+
+    def _spawn_worker_locked(self, actor_id: Optional[str] = None) -> _Worker:
+        worker_id = uuid.uuid4().hex[:12]
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER"] = "1"
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu.core.worker_proc",
+                self.sock_path,
+                self.store_path,
+                self.gcs_sock,
+                worker_id,
+                self.node_id,
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        w = _Worker(worker_id, proc)
+        w.actor_id = actor_id
+        self._workers[worker_id] = w
+        return w
+
+    # ---------------------------------------------------------- failures
+    def _store_error_for(self, entry: dict, error: BaseException) -> None:
+        for rid_hex in entry["return_ids"]:
+            oid = ObjectID.from_hex(rid_hex.decode() if isinstance(rid_hex, bytes) else rid_hex)
+            try:
+                self.store.put(oid, StoredError(error, entry.get("desc", "")))
+                self.gcs.call("add_object_location", oid.hex(), self.node_id)
+            except Exception:
+                pass
+
+    def _monitor_loop(self) -> None:
+        """Detects worker-process death; fails in-flight work and drives the
+        actor restart state machine (reference: node_manager worker-failure
+        handling + gcs_actor_manager.h:548)."""
+        while not self._stop.wait(0.2):
+            dead: List[_Worker] = []
+            with self._workers_lock:
+                for w in list(self._workers.values()):
+                    if w.proc.poll() is not None:
+                        dead.append(w)
+                        del self._workers[w.worker_id]
+                        if w.worker_id in self._idle:
+                            self._idle.remove(w.worker_id)
+            for w in dead:
+                entry = w.busy_with
+                if entry is not None:
+                    self._store_error_for(
+                        entry, RuntimeError(f"worker died executing {entry.get('desc','task')}")
+                    )
+                    if entry["type"] == "task":
+                        self._release(entry["resources"])
+                if w.actor_id is not None:
+                    self._on_actor_worker_death(w)
+
+    def _on_actor_worker_death(self, w: _Worker) -> None:
+        aid = w.actor_id
+        with self._actor_lock:
+            a = self._actors.get(aid)
+            if a is None:
+                return
+            was_dead = a["state"] == "DEAD"  # deliberate kill_actor()
+            a["state"] = "DEAD"
+            a["worker_id"] = None
+            inflight, a["inflight"] = list(a.get("inflight", [])), []
+            resources = a["resources"]
+            held, a["resources_held"] = a.get("resources_held", False), False
+        # Fail everything dispatched or queued to the dead worker so gets
+        # raise instead of hanging (reference: ActorDiedError path).
+        err = RuntimeError(f"actor {aid[:8]} died (worker process exited)")
+        for e in inflight:
+            self._store_error_for(e, err)
+        while True:
+            try:
+                m = w.mailbox.get_nowait()
+            except queue.Empty:
+                break
+            if m.get("type") == "task":
+                self._store_error_for(m["entry"], err)
+        if held:
+            self._release(resources)
+        if was_dead:
+            return  # killed deliberately; GCS already informed, no restart
+        decision = self.gcs.call("actor_died", aid, "worker process died", False)
+        if decision.get("restart"):
+            node = decision["node"]
+            spec_blob = decision["spec_blob"]
+            if node["node_id"] == self.node_id:
+                self.create_actor(spec_blob, forwarded=True)
+            else:
+                self._remote(node["sock"]).call("create_actor", spec_blob, True)
+
+    # ---------------------------------------------------------- lifecycle
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(1.0):
+            with self._res_lock:
+                avail = dict(self.available)
+            try:
+                self.gcs.call("heartbeat", self.node_id, avail)
+            except Exception:
+                pass
+
+    def ping(self) -> str:
+        return "pong"
+
+    def node_resources(self) -> Tuple[Dict[str, float], Dict[str, float]]:
+        with self._res_lock:
+            return dict(self.total), dict(self.available)
+
+    def stop(self) -> bool:
+        self._stop.set()
+        with self._workers_lock:
+            for w in self._workers.values():
+                w.mailbox.put({"type": "stop"})
+        time.sleep(0.1)
+        with self._workers_lock:
+            for w in self._workers.values():
+                if w.proc.poll() is None:
+                    w.proc.terminate()
+        return True
+
+
+def main(argv: List[str]) -> None:
+    node_id, sock_path, store_path, gcs_sock, resources_json, capacity = argv
+    import json
+
+    service = RayletService(
+        node_id,
+        sock_path,
+        store_path,
+        gcs_sock,
+        json.loads(resources_json),
+        int(capacity),
+    )
+    server = RpcServer(sock_path, service)
+    try:
+        while not service._stop.wait(0.5):
+            pass
+    finally:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

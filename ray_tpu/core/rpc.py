@@ -1,0 +1,170 @@
+"""Minimal UDS RPC: length-prefixed pickle messages, threaded server.
+
+Stands in for the reference's gRPC layer (reference: src/ray/rpc/ — gRPC
+client/server wrappers). Same shape: named handler methods on a service
+object, request/reply with correlation ids, a retrying client. Unix domain
+sockets because all nodes of the simulated cluster share one machine (the
+reference's Cluster fixture runs multiple raylets on one host the same
+way, python/ray/cluster_utils.py:135); swapping the transport for TCP is a
+address-string change.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+_HDR = struct.Struct("<I")
+
+
+def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("socket closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket) -> bytes:
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return _recv_exact(sock, n)
+
+
+class RpcServer:
+    """Serves `handler(method_name, *args, **kwargs)` calls over a UDS.
+
+    The service object's public methods are the RPC surface (mirrors the
+    reference's per-service gRPC handlers)."""
+
+    def __init__(self, path: str, service: Any):
+        self.path = path
+        self.service = service
+        if os.path.exists(path):
+            os.unlink(path)
+
+        server_self = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                while True:
+                    try:
+                        raw = _recv_msg(sock)
+                    except (ConnectionError, OSError):
+                        return
+                    req_id, method, args, kwargs = pickle.loads(raw)
+                    try:
+                        fn = getattr(server_self.service, method)
+                        result = fn(*args, **kwargs)
+                        reply = pickle.dumps((req_id, True, result))
+                    except BaseException as e:  # noqa: BLE001
+                        try:
+                            reply = pickle.dumps((req_id, False, e))
+                        except Exception:
+                            reply = pickle.dumps((req_id, False, RuntimeError(repr(e))))
+                    try:
+                        _send_msg(sock, reply)
+                    except (ConnectionError, OSError):
+                        return
+
+        class Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server(path, Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name=f"rpc-{os.path.basename(path)}", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class RpcClient:
+    """Client with per-thread connections, so a thread blocked in a
+    long-running call (e.g. a driver's `get`) never starves other threads'
+    requests. Retries connect (daemon may still be booting) — the analogue
+    of the reference's retryable gRPC client
+    (src/ray/rpc/retryable_grpc_client.h)."""
+
+    def __init__(self, path: str, connect_timeout: float = 20.0):
+        self.path = path
+        self._connect_timeout = connect_timeout
+        self._tls = threading.local()
+        self._all: list = []
+        self._all_lock = threading.Lock()
+        # Fail fast if the server is absent at construction.
+        self._get_sock()
+
+    def _new_sock(self, timeout: float) -> socket.socket:
+        deadline = time.monotonic() + timeout
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.connect(self.path)
+                with self._all_lock:
+                    self._all.append(s)
+                return s
+            except OSError as e:
+                last_err = e
+                time.sleep(0.05)
+        raise ConnectionError(f"cannot connect to {self.path}: {last_err}")
+
+    def _get_sock(self) -> socket.socket:
+        sock = getattr(self._tls, "sock", None)
+        if sock is None:
+            sock = self._new_sock(self._connect_timeout)
+            self._tls.sock = sock
+        return sock
+
+    def call(self, method: str, *args, timeout: Optional[float] = None, **kwargs) -> Any:
+        req_id = uuid.uuid4().hex
+        payload = pickle.dumps((req_id, method, args, kwargs))
+        sock = self._get_sock()
+        sock.settimeout(timeout)
+        try:
+            _send_msg(sock, payload)
+            raw = _recv_msg(sock)
+        except (ConnectionError, OSError):
+            # One reconnect attempt (daemon restarted).
+            sock.close()
+            sock = self._new_sock(5.0)
+            self._tls.sock = sock
+            _send_msg(sock, payload)
+            raw = _recv_msg(sock)
+        rid, ok, result = pickle.loads(raw)
+        if rid != req_id:
+            raise RuntimeError("rpc correlation mismatch")
+        if not ok:
+            raise result
+        return result
+
+    def close(self):
+        with self._all_lock:
+            for s in self._all:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._all = []
+        self._tls = threading.local()

@@ -1,0 +1,154 @@
+"""Worker process: long-polls its raylet for tasks and executes them.
+
+Re-design of the reference's worker loop (reference:
+python/ray/_private/workers/default_worker.py ->
+CoreWorkerProcess::RunTaskExecutionLoop, core_worker_process.h:100; task
+execution callback _raylet.pyx:1698 execute_task). The worker owns a full
+Runtime (ClusterRuntime in worker mode), so user tasks can themselves
+submit tasks, create actors, and call get/put — nested remote calls work
+exactly as on the driver.
+"""
+
+from __future__ import annotations
+
+import sys
+import traceback
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from .ids import ActorID, ObjectID
+from .task_spec import GLOBAL_FUNCTION_TABLE
+
+
+def _resolve_args(store, args_blob: bytes):
+    from .object_transport import StoredError
+    from .task_spec import ArgRef
+
+    args, kwargs = cloudpickle.loads(args_blob)
+
+    def fetch(a):
+        if isinstance(a, ArgRef):
+            v = store.get(a.object_id, timeout=30.0)
+            if isinstance(v, StoredError):
+                raise v.error
+            return v
+        return a
+
+    return tuple(fetch(a) for a in args), {k: fetch(v) for k, v in kwargs.items()}
+
+
+def main(argv: List[str]) -> None:
+    raylet_sock, store_path, gcs_sock, worker_id, node_id = argv
+
+    from .. import exceptions as exc
+    from . import runtime_base
+    from .cluster_runtime import ClusterRuntime
+    from .object_transport import StoredError
+    from .rpc import RpcClient
+    from .shm_store import SharedMemoryStore
+
+    store = SharedMemoryStore(store_path)
+    raylet = RpcClient(raylet_sock)
+    runtime = ClusterRuntime.attach(
+        gcs_sock=gcs_sock,
+        raylet_sock=raylet_sock,
+        store_path=store_path,
+        node_id=node_id,
+        driver=False,
+    )
+    runtime_base.set_runtime(runtime)
+
+    actor_instance: Dict[str, Any] = {}  # actor_id -> instance
+
+    def store_returns(entry: dict, result: Any) -> None:
+        rids = [ObjectID.from_hex(h) for h in entry["return_ids"]]
+        if len(rids) == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != len(rids):
+                raise ValueError(
+                    f"task returned {len(values)} values, expected {len(rids)}"
+                )
+        for rid, v in zip(rids, values):
+            store.put(rid, v)
+            raylet.call("notify_object", rid.hex())
+
+    def store_error(entry: dict, err: BaseException) -> None:
+        if not isinstance(err, exc.RayTpuError):
+            err = exc.TaskError(err, task_desc=entry.get("desc", ""))
+        for h in entry["return_ids"]:
+            rid = ObjectID.from_hex(h)
+            try:
+                store.put(rid, StoredError(err, entry.get("desc", "")))
+                raylet.call("notify_object", rid.hex())
+            except Exception:
+                pass
+
+    def execute(entry: dict) -> bool:
+        kind = entry["type"]
+        try:
+            if kind == "task":
+                fn = GLOBAL_FUNCTION_TABLE.loads(entry["func_blob"], entry["func_hash"])
+                args, kwargs = _resolve_args(store, entry["args_blob"])
+                result = fn(*args, **kwargs)
+                import inspect
+
+                if inspect.iscoroutine(result):
+                    import asyncio
+
+                    result = asyncio.run(result)
+                store_returns(entry, result)
+                return True
+            if kind == "actor_creation":
+                cls = GLOBAL_FUNCTION_TABLE.loads(entry["func_blob"], entry["func_hash"])
+                args, kwargs = _resolve_args(store, entry["args_blob"])
+                actor_instance[entry["actor_id"]] = cls(*args, **kwargs)
+                store_returns(entry, None)
+                return True
+            if kind == "actor_task":
+                inst = actor_instance.get(entry["actor_id"])
+                if inst is None:
+                    raise RuntimeError("actor instance missing in worker")
+                method = getattr(inst, entry["method_name"])
+                args, kwargs = _resolve_args(store, entry["args_blob"])
+                result = method(*args, **kwargs)
+                import inspect
+
+                if inspect.iscoroutine(result):
+                    import asyncio
+
+                    result = asyncio.run(result)
+                store_returns(entry, result)
+                return True
+            return True
+        except SystemExit:
+            store_returns(entry, None)
+            raise
+        except BaseException as e:  # noqa: BLE001
+            store_error(entry, e)
+            return False
+
+    while True:
+        try:
+            msg = raylet.call("worker_poll", worker_id, timeout=60.0)
+        except Exception:
+            return  # raylet gone
+        kind = msg.get("type")
+        if kind == "stop":
+            return
+        if kind == "noop":
+            continue
+        if kind == "task":
+            entry = msg["entry"]
+            try:
+                ok = execute(entry)
+            except SystemExit:
+                raylet.call("worker_done", worker_id, True)
+                return
+            raylet.call("worker_done", worker_id, ok)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -26,6 +26,11 @@ class TaskError(RayTpuError):
         self.task_desc = task_desc
         super().__init__(str(cause))
 
+    def __reduce__(self):
+        # Default exception pickling would re-init with args=(str(cause),),
+        # turning `cause` into a string on the consumer side.
+        return (TaskError, (self.cause, self.remote_tb, self.task_desc))
+
     def __str__(self):
         return (
             f"{type(self.cause).__name__}: {self.cause}\n"
