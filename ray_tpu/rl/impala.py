@@ -36,6 +36,7 @@ def vtrace(
     clip_rho: float = 1.0,
     clip_c: float = 1.0,
     terminateds=None,
+    mask=None,
 ):
     """V-trace targets over [T, N] tensors (jax, scan-based; reference:
     vtrace_torch.py / Espeholt et al. 2018 eq. 1).
@@ -51,6 +52,14 @@ def vtrace(
     chain = gamma * (1.0 - dones)
     values_tp1 = jnp.concatenate([values[1:], last_values[None]], axis=0)
     deltas = rho * (rewards + bootstrap * values_tp1 - values)
+    if mask is not None:
+        # Autoreset padding rows (mask=0) hold V(final_obs) of the episode
+        # that just truncated, and their done flag is 0 — zero the delta AND
+        # cut the chain there so vs[padding] collapses to exactly that
+        # bootstrap value instead of dragging next-episode corrections into
+        # the truncated step's advantage.
+        deltas = deltas * mask
+        chain = chain * mask
 
     def backward(acc, xs):
         delta_t, chain_t, c_t = xs
@@ -90,6 +99,7 @@ def impala_loss(
     vs, pg_adv = vtrace(
         batch["logp"], logp, batch["rewards"], values, batch["dones"],
         last_values, gamma=gamma, terminateds=batch.get("terminateds"),
+        mask=batch.get("mask"),
     )
     mask = batch.get("mask")
     policy_loss = -masked_mean(logp * pg_adv, mask)
@@ -178,6 +188,7 @@ class IMPALA:
             "logp": rollout["logp"],
             "rewards": rollout["rewards"],
             "dones": rollout["dones"],
+            "terminateds": rollout["terminateds"],
             "mask": rollout["mask"],
             "last_obs": rollout["last_obs"],
         }
