@@ -40,11 +40,23 @@ def _peak_flops(device) -> float:
 
 
 def main() -> None:
+    import argparse
+
     import jax
     import jax.numpy as jnp
     import optax
 
     from ray_tpu.models import transformer as tfm
+
+    ap = argparse.ArgumentParser()
+    # "none" outruns "dots" here: saving fp32 dot outputs for this model
+    # exceeds v5e HBM, while full recompute keeps step math MXU-bound.
+    ap.add_argument("--remat-policy", default="none", choices=["none", "dots"])
+    ap.add_argument("--heads", type=int, default=8)  # head_dim 128 = MXU/VPU lane width
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--attn", default="full")
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
@@ -54,14 +66,16 @@ def main() -> None:
             vocab_size=32768,
             d_model=1024,
             n_layers=16,
-            n_heads=16,
-            n_kv_heads=16,
+            n_heads=args.heads,
+            n_kv_heads=args.heads,
             d_ff=4096,
             max_seq_len=2048,
             dtype=jnp.bfloat16,
             remat=True,
+            remat_policy=None if args.remat_policy == "none" else args.remat_policy,
+            attn_impl=args.attn,
         )
-        batch, seq, steps, warmup = 8, 2048, 10, 2
+        batch, seq, steps, warmup = args.batch, 2048, args.steps, 2
     else:  # smoke-test shape for CPU runs
         cfg = tfm.tiny(dtype=jnp.float32)
         batch, seq, steps, warmup = 2, 64, 3, 1

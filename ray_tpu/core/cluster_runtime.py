@@ -58,6 +58,8 @@ def _entry_from_spec(spec: TaskSpec) -> dict:
         "resources": resources,
         "actor_id": spec.actor_id.hex() if spec.actor_id else None,
         "max_restarts": spec.options.max_restarts,
+        "pg_id": spec.options.placement_group_id,
+        "bundle_index": spec.options.bundle_index,
         "name": spec.options.name,
         "namespace": spec.options.namespace,
         "desc": spec.description(),
@@ -200,6 +202,19 @@ class ClusterRuntime(Runtime):
     def submit_task(self, spec: TaskSpec) -> List[ObjectID]:
         entry = _entry_from_spec(spec)
         spec.return_ids = [ObjectID.from_hex(h) for h in entry["return_ids"]]
+        if entry.get("pg_id"):
+            # Bundle-pinned: route straight to the node holding the reserved
+            # bundle (reference: bundle scheduling bypasses the hybrid
+            # policy, scheduling_policy.h NodeAffinity-like pinning).
+            target = self._gcs.call("pick_bundle", entry["pg_id"], entry["bundle_index"])
+            if target is None:
+                raise RuntimeError(
+                    f"placement group {entry['pg_id'][:8]} bundle "
+                    f"{entry['bundle_index']} is not schedulable"
+                )
+            entry["bundle_index"] = target["bundle_index"]
+            self._raylet_for(target["sock"]).call("submit_task", pickle.dumps(entry))
+            return spec.return_ids
         self._raylet.call("submit_task", pickle.dumps(entry))
         return spec.return_ids
 
@@ -217,8 +232,12 @@ class ClusterRuntime(Runtime):
             spec.options.max_restarts,
             spec.options.name,
             spec.options.namespace,
+            spec.options.placement_group_id,
+            spec.options.bundle_index,
         )
-        self._raylet_for(node["sock"]).call("create_actor", blob, True)
+        self._raylet_for(node["sock"]).call(
+            "create_actor", blob, True, node.get("bundle_index")
+        )
         self._actor_location[actor_id.hex()] = node["sock"]
         return actor_id
 

@@ -33,6 +33,7 @@ class GcsService:
         self._objects: Dict[str, Set[str]] = {}
         self._kv: Dict[str, bytes] = {}
         self._pgs: Dict[str, dict] = {}
+        self._raylet_clients: Dict[str, Any] = {}
         self._stop = threading.Event()
         self._health = threading.Thread(target=self._health_loop, daemon=True)
         self._health.start()
@@ -138,6 +139,14 @@ class GcsService:
         become restart candidates (reference: gcs_node_manager death
         handling -> gcs_actor_manager restart :548)."""
         with self._lock:
+            n = self._nodes.get(node_id)
+            if n is not None:
+                cli = self._raylet_clients.pop(n["sock"], None)
+                if cli is not None:
+                    try:
+                        cli.close()
+                    except Exception:
+                        pass
             for locs in self._objects.values():
                 locs.discard(node_id)
             for aid, a in self._actors.items():
@@ -168,20 +177,39 @@ class GcsService:
         max_restarts: int,
         name: Optional[str],
         namespace: Optional[str],
+        pg_id: Optional[str] = None,
+        bundle_index: int = -1,
     ) -> dict:
         """Registers + places an actor; returns the chosen node (the caller
         raylet/driver forwards the creation there). Reference:
-        gcs_actor_manager.h RegisterActor + gcs_actor_scheduler placement."""
-        with self._lock:
-            if name:
-                key = (namespace or "default", name)
+        gcs_actor_manager.h RegisterActor + gcs_actor_scheduler placement.
+        Bundle-pinned actors go to their reserved bundle\'s node."""
+        key = (namespace or "default", name) if name else None
+        if key is not None:
+            # Claim the name up front so two concurrent registrations cannot
+            # both pass the uniqueness check while pick_node runs (TOCTOU).
+            with self._lock:
                 if key in self._named:
                     raise ValueError(f"actor name {name!r} already taken")
-            node = None
-        node = self.pick_node(resources)
+                self._named[key] = actor_id
+        try:
+            if pg_id:
+                node = self.pick_bundle(pg_id, bundle_index)
+                if node is None:
+                    raise RuntimeError(
+                        f"placement group {pg_id[:8]} bundle {bundle_index} not available"
+                    )
+            else:
+                node = self.pick_node(resources)
+                if node is None:
+                    raise RuntimeError(f"no node can host actor requiring {resources}")
+        except BaseException:
+            if key is not None:
+                with self._lock:
+                    if self._named.get(key) == actor_id:
+                        del self._named[key]
+            raise
         with self._lock:
-            if node is None:
-                raise RuntimeError(f"no node can host actor requiring {resources}")
             self._actors[actor_id] = {
                 "state": "PENDING",
                 "node_id": node["node_id"],
@@ -189,12 +217,12 @@ class GcsService:
                 "resources": dict(resources),
                 "max_restarts": max_restarts,
                 "num_restarts": 0,
+                "pg_id": pg_id,
+                "bundle_index": node.get("bundle_index", bundle_index) if pg_id else -1,
                 "name": name,
                 "namespace": namespace or "default",
                 "death_reason": "",
             }
-            if name:
-                self._named[(namespace or "default", name)] = actor_id
         return node
 
     def actor_started(self, actor_id: str, node_id: str) -> bool:
@@ -221,7 +249,13 @@ class GcsService:
             a["num_restarts"] += 1
             a["state"] = "RESTARTING"
             resources = dict(a["resources"])
-        node = self.pick_node(resources)
+            pg_id = a.get("pg_id")
+            bundle_index = a.get("bundle_index", -1)
+        if pg_id:
+            # Bundle-pinned actors restart on their reserved bundle.
+            node = self.pick_bundle(pg_id, bundle_index)
+        else:
+            node = self.pick_node(resources)
         with self._lock:
             a = self._actors[actor_id]
             if node is None:
@@ -231,6 +265,7 @@ class GcsService:
                 return {"restart": False}
             a["node_id"] = node["node_id"]
             return {"restart": True, "node": node, "spec_blob": a["spec_blob"],
+                    "bundle_index": node.get("bundle_index", -1),
                     "num_restarts": a["num_restarts"]}
 
     def get_actor(self, actor_id: str) -> Optional[dict]:
@@ -281,14 +316,18 @@ class GcsService:
             return [k for k in self._kv if k.startswith(prefix)]
 
     # ------------------------------------------------------ placement grp
-    def create_placement_group(self, pg_id: str, bundles: List[dict], strategy: str) -> dict:
-        """Places bundles per policy (reference: bundle_scheduling_policy.h
-        PACK/SPREAD/STRICT_PACK/STRICT_SPREAD + the TPU-native SLICE_GANG).
-        Returns {placements: [node_id per bundle]} or raises."""
+    def _plan_bundles(
+        self, bundles: List[dict], strategy: str, banned: Set[str]
+    ) -> List[str]:
+        """Pure placement planning against the current resource view
+        (reference: bundle_scheduling_policy.h PACK/SPREAD/STRICT_PACK/
+        STRICT_SPREAD + the TPU-native SLICE_GANG)."""
         placements: List[str] = []
         with self._lock:
             avail = {
-                nid: dict(n["available"]) for nid, n in self._nodes.items() if n["alive"]
+                nid: dict(n["available"])
+                for nid, n in self._nodes.items()
+                if n["alive"] and nid not in banned
             }
         order = sorted(avail, key=lambda nid: -sum(avail[nid].values()))
 
@@ -327,34 +366,129 @@ class GcsService:
                 )
             take(chosen, bundle)
             placements.append(chosen)
+        return placements
 
-        with self._lock:
-            # SLICE_GANG: atomic lease — resources deducted together so the
-            # whole gang either fits or the creation fails (replaces the
-            # TPU-{pod}-head idiom, reference: accelerators/tpu.py:334-397).
-            for nid, bundle in zip(placements, bundles):
-                n = self._nodes.get(nid)
-                if n:
-                    for k, v in bundle.items():
-                        n["available"][k] = n["available"].get(k, 0.0) - v
-            self._pgs[pg_id] = {
-                "bundles": bundles,
-                "strategy": strategy,
-                "placements": placements,
-                "state": "CREATED",
-            }
-        return {"placements": placements}
+    def create_placement_group(self, pg_id: str, bundles: List[dict], strategy: str) -> dict:
+        """Plans placements, then leases each bundle on its raylet — the
+        raylet debits its own free pool, so the reservation is durable
+        across heartbeats (reference: gcs_placement_group_scheduler.h:283
+        two-phase PREPARE/COMMIT; placement_group_resource_manager.h).
+        All-or-nothing: any failed lease rolls the gang back."""
+        banned: Set[str] = set()
+        last_err: Optional[str] = None
+        for _ in range(4):  # replanning rounds for stale-view refusals
+            placements = self._plan_bundles(bundles, strategy, banned)
+            reserved: List[Tuple[str, int]] = []
+            failed_node = None
+            for i, (nid, bundle) in enumerate(zip(placements, bundles)):
+                with self._lock:
+                    node = self._nodes.get(nid)
+                    sock = node["sock"] if node and node["alive"] else None
+                ok = False
+                if sock is not None:
+                    try:
+                        ok = self._raylet_call(sock, "reserve_bundle", pg_id, i, bundle)
+                    except Exception:
+                        ok = False
+                if not ok:
+                    failed_node = nid
+                    break
+                reserved.append((nid, i))
+            if failed_node is None:
+                # Refresh the view from each leasing raylet (authoritative,
+                # post-reserve) rather than debiting locally — a concurrent
+                # heartbeat that already reflects the lease would otherwise
+                # be debited twice.
+                for nid in set(placements):
+                    with self._lock:
+                        node = self._nodes.get(nid)
+                        sock = node["sock"] if node else None
+                    if sock:
+                        try:
+                            _, avail = self._raylet_call(sock, "node_resources")
+                            with self._lock:
+                                node = self._nodes.get(nid)
+                                if node:
+                                    node["available"] = dict(avail)
+                        except Exception:
+                            pass
+                with self._lock:
+                    self._pgs[pg_id] = {
+                        "bundles": bundles,
+                        "strategy": strategy,
+                        "placements": placements,
+                        "state": "CREATED",
+                        "rr": 0,
+                    }
+                return {"placements": placements}
+            # Roll back partial gang, ban the refusing node, replan.
+            for nid, i in reserved:
+                with self._lock:
+                    node = self._nodes.get(nid)
+                    sock = node["sock"] if node else None
+                if sock:
+                    try:
+                        self._raylet_call(sock, "release_bundle", pg_id, i)
+                    except Exception:
+                        pass
+            banned.add(failed_node)
+            last_err = f"node {failed_node[:8]} refused bundle lease"
+        raise RuntimeError(f"placement group {pg_id[:8]} creation failed: {last_err}")
+
+    def _raylet_call(self, sock: str, method: str, *args):
+        """Cached per-raylet client for control-plane calls (bundle
+        lease/release, view refresh) — never on the task fast path. Entries
+        are evicted when their node dies (_on_node_death)."""
+        from .rpc import RpcClient
+
+        cli = self._raylet_clients.get(sock)
+        if cli is None:
+            cli = RpcClient(sock)
+            self._raylet_clients[sock] = cli
+        return cli.call(method, *args)
 
     def remove_placement_group(self, pg_id: str) -> bool:
         with self._lock:
             pg = self._pgs.pop(pg_id, None)
-            if pg:
-                for nid, bundle in zip(pg["placements"], pg["bundles"]):
+        if pg:
+            for i, (nid, bundle) in enumerate(zip(pg["placements"], pg["bundles"])):
+                with self._lock:
                     n = self._nodes.get(nid)
+                    sock = n["sock"] if n and n["alive"] else None
                     if n:
                         for k, v in bundle.items():
-                            n["available"][k] = n["available"].get(k, 0.0) + v
+                            n["available"][k] = min(
+                                n["resources"].get(k, 0.0), n["available"].get(k, 0.0) + v
+                            )
+                if sock:
+                    try:
+                        self._raylet_call(sock, "release_bundle", pg_id, i)
+                    except Exception:
+                        pass
         return True
+
+    def pick_bundle(self, pg_id: str, bundle_index: int) -> Optional[dict]:
+        """Resolves a (pg, bundle) to its host node for bundle-pinned
+        submission; bundle_index -1 round-robins across the gang."""
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            if pg is None:
+                return None
+            if bundle_index < 0:
+                bundle_index = pg["rr"] % len(pg["placements"])
+                pg["rr"] += 1
+            if bundle_index >= len(pg["placements"]):
+                return None
+            nid = pg["placements"][bundle_index]
+            n = self._nodes.get(nid)
+            if n is None or not n["alive"]:
+                return None
+            return {
+                "node_id": nid,
+                "sock": n["sock"],
+                "store": n["store"],
+                "bundle_index": bundle_index,
+            }
 
     def placement_group_table(self) -> Dict[str, dict]:
         with self._lock:

@@ -62,6 +62,12 @@ class RayletService:
         self.total = dict(resources)
         self.available = dict(resources)
         self._res_lock = threading.Lock()
+        # Placement-group bundle reservations hosted on this node:
+        # (pg_id, bundle_index) -> {"reserved": {...}, "free": {...}}.
+        # Reserved resources are deducted from `available`, so heartbeats
+        # naturally reflect the lease (reference:
+        # placement_group_resource_manager.h — the raylet owns bundle state).
+        self._bundles: Dict[Tuple[str, int], dict] = {}
 
         self._workers: Dict[str, _Worker] = {}
         self._idle: List[str] = []
@@ -110,12 +116,111 @@ class RayletService:
     def _fits_total(self, resources: Dict[str, float]) -> bool:
         return all(self.total.get(k, 0.0) >= v for k, v in resources.items())
 
+    # ------------------------------------------------- placement bundles
+    def reserve_bundle(self, pg_id: str, bundle_index: int, resources: Dict[str, float]) -> bool:
+        """Leases a PG bundle out of this node's free pool. The reservation
+        survives heartbeats because it is debited from `available` here, at
+        the source of truth."""
+        with self._res_lock:
+            key = (pg_id, bundle_index)
+            if key in self._bundles:
+                return True  # idempotent retry
+            if not all(self.available.get(k, 0.0) >= v for k, v in resources.items()):
+                return False
+            for k, v in resources.items():
+                self.available[k] = self.available.get(k, 0.0) - v
+            self._bundles[key] = {"reserved": dict(resources), "free": dict(resources)}
+        return True
+
+    def release_bundle(self, pg_id: str, bundle_index: int) -> bool:
+        with self._res_lock:
+            b = self._bundles.pop((pg_id, bundle_index), None)
+            if b is None:
+                return False
+            for k, v in b["reserved"].items():
+                self.available[k] = min(self.total.get(k, 0.0), self.available.get(k, 0.0) + v)
+        return True
+
+    def _fail_if_unschedulable(self, entry: dict) -> bool:
+        """Bundle-pinned work whose bundle is gone (PG removed) or whose
+        request exceeds the bundle's whole reservation can never dispatch:
+        fail it now so get() raises instead of hanging (reference: Ray fails
+        tasks of removed placement groups)."""
+        key = self._entry_bundle_key(entry)
+        if key is None:
+            return False
+        with self._res_lock:
+            b = self._bundles.get(key)
+            reserved = dict(b["reserved"]) if b else None
+        if reserved is None:
+            self._store_error_for(
+                entry,
+                RuntimeError(
+                    f"placement group {key[0][:8]} bundle {key[1]} is not "
+                    "reserved on this node (placement group removed?)"
+                ),
+            )
+            return True
+        if not all(reserved.get(k, 0.0) >= v for k, v in entry["resources"].items()):
+            self._store_error_for(
+                entry,
+                RuntimeError(
+                    f"task requires {entry['resources']} but bundle {key[1]} "
+                    f"of placement group {key[0][:8]} only reserves {reserved}"
+                ),
+            )
+            return True
+        return False
+
+    def _entry_bundle_key(self, entry: dict) -> Optional[Tuple[str, int]]:
+        pg_id = entry.get("pg_id")
+        if not pg_id:
+            return None
+        return (pg_id, entry.get("bundle_index", 0))
+
+    def _try_acquire_entry(self, entry: dict) -> bool:
+        """Acquires the entry's resources — from its PG bundle's reserved
+        pool when it has one, else from the node's free pool."""
+        key = self._entry_bundle_key(entry)
+        if key is None:
+            return self._try_acquire(entry["resources"])
+        with self._res_lock:
+            b = self._bundles.get(key)
+            if b is None:
+                # Bundle not (yet) reserved here — e.g. reservation RPC still
+                # in flight. Keep the task queued.
+                return False
+            free = b["free"]
+            if not all(free.get(k, 0.0) >= v for k, v in entry["resources"].items()):
+                return False
+            for k, v in entry["resources"].items():
+                free[k] = free.get(k, 0.0) - v
+        return True
+
+    def _release_entry(self, entry: dict) -> None:
+        key = self._entry_bundle_key(entry)
+        if key is None:
+            self._release(entry["resources"])
+            return
+        with self._res_lock:
+            b = self._bundles.get(key)
+            if b is None:
+                return  # bundle was released while the task ran
+            cap = b["reserved"]
+            for k, v in entry["resources"].items():
+                b["free"][k] = min(cap.get(k, 0.0), b["free"].get(k, 0.0) + v)
+
     # ----------------------------------------------------------- ingress
     def submit_task(self, spec_blob: bytes, forwarded: bool = False) -> List[bytes]:
         """Queues a normal task; returns return-object ids. May forward to
         another node (spillback, reference: cluster_task_manager.cc:136)."""
         entry = pickle.loads(spec_blob)
         resources = entry["resources"]
+        if entry.get("pg_id"):
+            # Bundle-pinned: the driver routed it to this node; never spill.
+            entry["type"] = "task"
+            self._pending.put(entry)
+            return entry["return_ids"]
         if not forwarded:
             # Cluster-level decision: if it can't run here (ever, or not
             # soon) and another node has room now, forward it.
@@ -144,16 +249,22 @@ class RayletService:
         with self._res_lock:
             return all(self.available.get(k, 0.0) >= v for k, v in resources.items())
 
-    def create_actor(self, spec_blob: bytes, forwarded: bool = False) -> bool:
-        """Hosts an actor (the GCS already picked this node)."""
+    def create_actor(
+        self, spec_blob: bytes, forwarded: bool = False, bundle_index: Optional[int] = None
+    ) -> bool:
+        """Hosts an actor (the GCS already picked this node). `bundle_index`
+        carries the GCS-resolved bundle when the caller's spec said -1."""
         entry = pickle.loads(spec_blob)
         entry["type"] = "actor_creation"
+        if bundle_index is not None and bundle_index >= 0:
+            entry["bundle_index"] = bundle_index
         with self._actor_lock:
             self._actors[entry["actor_id"]] = {
                 "worker_id": None,
                 "state": "PENDING",
                 "inflight": [],  # dispatched actor tasks, FIFO (serial exec)
                 "spec_blob": spec_blob,
+                "creation_entry": entry,  # resource/bundle accounting handle
                 "resources": entry["resources"],
                 "resources_held": False,
             }
@@ -258,7 +369,7 @@ class RayletService:
                     a["inflight"].pop(0)
         if entry is not None:
             if entry["type"] == "task":
-                self._release(entry["resources"])
+                self._release_entry(entry)
             elif entry["type"] == "actor_creation":
                 aid = entry["actor_id"]
                 if ok:
@@ -309,17 +420,28 @@ class RayletService:
     def _dispatch(self, entry: dict) -> bool:
         kind = entry["type"]
         if kind == "task":
-            if not self._try_acquire(entry["resources"]):
+            if self._fail_if_unschedulable(entry):
+                return True
+            if not self._try_acquire_entry(entry):
                 return False
             w = self._checkout_worker()
             if w is None:
-                self._release(entry["resources"])
+                self._release_entry(entry)
                 return False
             w.busy_with = entry
             w.mailbox.put({"type": "task", "entry": entry})
             return True
         if kind == "actor_creation":
-            if not self._try_acquire(entry["resources"]):
+            if self._fail_if_unschedulable(entry):
+                with self._actor_lock:
+                    a = self._actors.get(entry["actor_id"])
+                    if a:
+                        a["state"] = "DEAD"
+                self.gcs.call(
+                    "actor_died", entry["actor_id"], "placement bundle gone", True
+                )
+                return True
+            if not self._try_acquire_entry(entry):
                 return False
             w = self._spawn_worker(actor_id=entry["actor_id"])
             with self._actor_lock:
@@ -422,7 +544,7 @@ class RayletService:
                         entry, RuntimeError(f"worker died executing {entry.get('desc','task')}")
                     )
                     if entry["type"] == "task":
-                        self._release(entry["resources"])
+                        self._release_entry(entry)
                 if w.actor_id is not None:
                     self._on_actor_worker_death(w)
 
@@ -436,7 +558,7 @@ class RayletService:
             a["state"] = "DEAD"
             a["worker_id"] = None
             inflight, a["inflight"] = list(a.get("inflight", [])), []
-            resources = a["resources"]
+            creation_entry = a.get("creation_entry")
             held, a["resources_held"] = a.get("resources_held", False), False
         # Fail everything dispatched or queued to the dead worker so gets
         # raise instead of hanging (reference: ActorDiedError path).
@@ -450,18 +572,19 @@ class RayletService:
                 break
             if m.get("type") == "task":
                 self._store_error_for(m["entry"], err)
-        if held:
-            self._release(resources)
+        if held and creation_entry is not None:
+            self._release_entry(creation_entry)
         if was_dead:
             return  # killed deliberately; GCS already informed, no restart
         decision = self.gcs.call("actor_died", aid, "worker process died", False)
         if decision.get("restart"):
             node = decision["node"]
             spec_blob = decision["spec_blob"]
+            bidx = decision.get("bundle_index")
             if node["node_id"] == self.node_id:
-                self.create_actor(spec_blob, forwarded=True)
+                self.create_actor(spec_blob, forwarded=True, bundle_index=bidx)
             else:
-                self._remote(node["sock"]).call("create_actor", spec_blob, True)
+                self._remote(node["sock"]).call("create_actor", spec_blob, True, bidx)
 
     # ---------------------------------------------------------- lifecycle
     def _heartbeat_loop(self) -> None:

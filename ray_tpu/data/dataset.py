@@ -283,11 +283,34 @@ class Dataset:
         n_actors = max(1, op.concurrency or 1)
         actor_cls = api.remote(max_concurrency=2)(_BatchMapActor)
         blob = cloudpickle.dumps(op.fn)
-        actors = [actor_cls.remote(blob) for _ in _range(n_actors)]
-        return (
-            actors[i % n_actors].apply.remote(r, op.batch_size, op.batch_format)
-            for i, r in enumerate(refs)
-        )
+
+        def run():
+            # Kill the pool when the stage drains (or the consumer stops
+            # iterating): each execution owns its actors, and leaking one
+            # worker process per epoch per actor adds up fast. In-flight
+            # applies are awaited first so the kill can't fail them. Actors
+            # are created lazily here so a consumer that never starts the
+            # stage doesn't strand a pool (a GEN_CREATED generator's finally
+            # never runs).
+            actors = [actor_cls.remote(blob) for _ in _range(n_actors)]
+            issued = []
+            try:
+                for i, r in enumerate(refs):
+                    out = actors[i % n_actors].apply.remote(r, op.batch_size, op.batch_format)
+                    issued.append(out)
+                    yield out
+            finally:
+                try:
+                    api.wait(issued, num_returns=len(issued), timeout=60)
+                except Exception:
+                    pass
+                for a in actors:
+                    try:
+                        api.kill(a)
+                    except Exception:
+                        pass
+
+        return run()
 
     def _repartition(self, refs: List[Any], n: int) -> List[Any]:
         blocks = api.get(refs)

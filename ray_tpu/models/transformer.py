@@ -51,8 +51,13 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
-    attn_impl: str = "full"  # "full" | "ring" | "ulysses"
+    attn_impl: str = "full"  # "full" (fused/flash) | "naive" | "ring" | "ulysses"
     remat: bool = True
+    # "dots": save matmul outputs, recompute only elementwise ops on the
+    # backward pass (jax.checkpoint_policies) — the right default on TPU
+    # where HBM usually fits the dots and recomputing matmuls wastes MXU.
+    # None: save nothing (lowest memory, recompute everything).
+    remat_policy: Optional[str] = "dots"
     tie_embeddings: bool = False
 
     @property
@@ -157,6 +162,12 @@ def apply_rope(x, cos, sin):
 
 
 def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
+    if cfg.attn_impl == "full":
+        # Fused pallas kernel (handles GQA internally; falls back to the
+        # unfused path for untileable shapes).
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
     if cfg.n_kv_heads != cfg.n_heads:
         rep = cfg.n_heads // cfg.n_kv_heads
         k = jnp.repeat(k, rep, axis=2)
@@ -214,7 +225,11 @@ def forward(
 
     body = partial(_layer, cfg=cfg, cos=cos, sin=sin, mesh=mesh)
     if cfg.remat:
-        body = jax.checkpoint(body)
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        else:
+            policy = None
+        body = jax.checkpoint(body, policy=policy)
 
     def scan_step(x, layer_params):
         return body(x, layer_params), None

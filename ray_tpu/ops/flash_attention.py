@@ -1,0 +1,329 @@
+"""Fused (flash) attention as a pallas TPU kernel.
+
+The reference has no attention kernels at all — its training path delegates
+model math to torch/DeepSpeed user code (reference:
+python/ray/train/torch/train_loop_utils.py:162, release/air_examples/
+gptj_deepspeed_finetuning/). A TPU-native framework must own this op: naive
+attention materializes the [b, h, s, s] score matrix in HBM, which turns the
+attention layers from MXU-bound into HBM-bandwidth-bound and caps whole-model
+MFU. This kernel streams K/V blocks through VMEM with an online softmax
+(Dao et al., FlashAttention; Rabe & Staats, blockwise attention) so the
+score matrix never leaves the chip.
+
+Design notes (TPU-first):
+- layout inside the kernels is [batch*heads, seq, head_dim]; the grid walks
+  (bh, q_block, k_block) with the k_block axis innermost so the running
+  (max, normalizer, accumulator) live in VMEM scratch across the inner loop;
+- matmuls use fp32 accumulation (`preferred_element_type`) on the MXU, with
+  probabilities cast back to the input dtype for the P@V contraction;
+- causal blocks entirely above the diagonal are skipped (predicated out) —
+  ~2x FLOP saving at long sequence;
+- backward = two kernels (dq; dk/dv) recomputing probabilities from the
+  saved logsumexp, the standard flash-backward decomposition;
+- `interpret=True` (auto-selected off-TPU) runs the same kernels on CPU for
+  tests; the multi-chip ring/Ulysses paths compose on top of this per-shard
+  kernel via shard_map.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is unavailable on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+DEFAULT_BLOCK = 512
+NEG_INF = -1e30
+
+
+def _dot(a, b, contract=((1,), (0,))):
+    return lax.dot_general(
+        a, b, dimension_numbers=(contract, ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k, num_k):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    # Last k block this q block attends to (causal) — also where we emit.
+    last_k = jnp.minimum(num_k - 1, (q_start + block_q - 1) // block_k) if causal else num_k - 1
+
+    @pl.when(ik <= last_k)
+    def _():
+        q = q_ref[0]  # [bq, d]
+        k = k_ref[0]  # [bk, d]
+        s = _dot(q, k, contract=((1,), (1,))) * scale  # [bq, bk] fp32
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
+        acc = acc_scr[:] * alpha[:, None] + _dot(p.astype(v_ref.dtype), v_ref[0])
+        m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+        acc_scr[:] = acc
+
+    @pl.when(ik == (last_k if causal else num_k - 1))
+    def _():
+        l = jnp.maximum(l_scr[:, 0], 1e-30)
+        o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:, 0] + jnp.log(l))[:, None].astype(lse_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *, scale, causal, block_q, block_k, num_k):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    last_k = jnp.minimum(num_k - 1, (q_start + block_q - 1) // block_k) if causal else num_k - 1
+
+    @pl.when(ik <= last_k)
+    def _():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        s = _dot(q, k, contract=((1,), (1,))) * scale
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])  # lse_ref[0]: [bq, 1] broadcasts
+        dp = _dot(do_ref[0], v, contract=((1,), (1,)))  # [bq, bk]
+        ds = p * (dp - delta_ref[0]) * scale
+        dq_scr[:] += _dot(ds.astype(k.dtype), k)
+
+    @pl.when(ik == (last_k if causal else num_k - 1))
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, block_q, block_k, num_q):
+    ik, iq = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    # First q block at/below the diagonal for this k block.
+    not_skipped = (q_start + block_q - 1) >= k_start if causal else True
+
+    @pl.when(not_skipped)
+    def _():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        do = do_ref[0]
+        s = _dot(q, k, contract=((1,), (1,))) * scale
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])  # lse_ref[0]: [bq, 1] broadcasts
+        dv_scr[:] += _dot(p.astype(do.dtype), do, contract=((0,), (0,)))  # [bk, d]
+        dp = _dot(do, v, contract=((1,), (1,)))
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_scr[:] += _dot(ds.astype(q.dtype), q, contract=((0,), (0,)))
+
+    @pl.when(iq == num_q - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _auto_interpret() -> bool:
+    """True when the computation will run on CPU (tests / virtual meshes).
+
+    Checked in priority order: the framework's platform pin
+    (RAY_TPU_PLATFORM=cpu, set by the test conftest and CPU-mesh scripts),
+    then an overridden jax default device, then the default backend.
+    """
+    import os
+
+    if os.environ.get("RAY_TPU_PLATFORM", "").lower() == "cpu":
+        return True
+    dd = jax.config.jax_default_device
+    if dd is not None:
+        return getattr(dd, "platform", None) == "cpu"
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block(s: int, want: int) -> Optional[int]:
+    """Largest power-of-two tile <= want dividing s; None when s has no
+    8-aligned tiling (caller falls back to the unfused path)."""
+    for b in (want, 512, 256, 128, 64, 32, 16, 8):
+        if b <= want and s % b == 0:
+            return b
+    return None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
+    bh, s, d = q.shape
+    nq, nk = s // block_q, s // block_k
+    kern = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k, num_k=nk
+    )
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            _scratch((block_q, 128), jnp.float32, interpret),
+            _scratch((block_q, 128), jnp.float32, interpret),
+            _scratch((block_q, d), jnp.float32, interpret),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _scratch(shape, dtype, interpret):
+    if pltpu is not None and not interpret:
+        return pltpu.VMEM(shape, dtype)
+    if pltpu is not None:
+        return pltpu.VMEM(shape, dtype)  # interpreter accepts VMEM scratch
+    raise RuntimeError("pallas TPU backend unavailable")
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    bh, s, d = q.shape
+    nq, nk = s // block_q, s // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # [bh, s, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k, num_k=nk
+        ),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[_scratch((block_q, d), jnp.float32, interpret)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k, num_q=nq
+        ),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            _scratch((block_k, d), jnp.float32, interpret),
+            _scratch((block_k, d), jnp.float32, interpret),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused attention over [batch, seq, heads, head_dim] inputs.
+
+    Exact (not approximate) attention; O(s) memory per core. Falls back to
+    unfused attention for shapes the kernel cannot tile. `interpret` defaults
+    to True off-TPU so the same kernel runs (slowly) on CPU for tests.
+    """
+    b, s, h, d = q.shape
+    if k.shape[2] != h:  # GQA: expand kv heads to q heads
+        rep = h // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = scale if scale is not None else d**-0.5
+    if interpret is None:
+        interpret = _auto_interpret()
+    bq, bk = _pick_block(s, block_q), _pick_block(s, block_k)
+    if pltpu is None or bq is None or bk is None:
+        from ..parallel.ring_attention import attention_reference
+
+        return attention_reference(q, k, v, causal=causal, scale=scale)
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, scale, bq, bk, interpret)
+    return o.reshape(b, h, s, d).transpose(0, 2, 1, 3)

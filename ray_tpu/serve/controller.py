@@ -72,6 +72,7 @@ class ServeController:
     def __init__(self):
         self._apps: Dict[str, Dict[str, Any]] = {}  # app -> spec
         self._replicas: Dict[str, List[Any]] = {}  # app -> replica handles
+        self._app_gen: Dict[str, int] = {}  # bumped on deploy/delete
         self._version = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -107,6 +108,7 @@ class ServeController:
             # blob and must be torn down so the reconciler rebuilds them
             # (reference: deployment_state version-change rollout).
             self._replicas[app_name] = []
+            self._app_gen[app_name] = self._app_gen.get(app_name, 0) + 1
             self._version += 1
         for r in old_replicas:
             try:
@@ -120,6 +122,7 @@ class ServeController:
         with self._lock:
             self._apps.pop(app_name, None)
             replicas = self._replicas.pop(app_name, [])
+            self._app_gen[app_name] = self._app_gen.get(app_name, 0) + 1
             self._version += 1
         for r in replicas:
             try:
@@ -131,19 +134,24 @@ class ServeController:
     # ---------------------------------------------------------- reconcile
     def _reconcile(self) -> None:
         """Drives actual replica sets toward targets (reference:
-        deployment_state.py DeploymentState.update)."""
+        deployment_state.py DeploymentState.update). Write-back is guarded
+        by a per-app generation so a concurrent deploy()/delete_app() (which
+        resets the replica list) is never clobbered by an in-flight pass."""
         with self._lock:
             apps = dict(self._apps)
+            gens = dict(self._app_gen)
         for name, spec in apps.items():
-            current = self._replicas.get(name, [])
+            with self._lock:
+                current = list(self._replicas.get(name, []))
             target = spec["target_replicas"]
             opts = {"max_concurrency": spec["max_ongoing"], **spec["actor_options"]}
             replica_cls = api.remote(**opts)(Replica)
             changed = False
+            created = []
             while len(current) < target:
-                current.append(
-                    replica_cls.remote(spec["cls_blob"], spec["init_args"], spec["init_kwargs"])
-                )
+                r = replica_cls.remote(spec["cls_blob"], spec["init_args"], spec["init_kwargs"])
+                current.append(r)
+                created.append(r)
                 changed = True
             while len(current) > target:
                 victim = current.pop()
@@ -153,9 +161,19 @@ class ServeController:
                 except Exception:
                     pass
             with self._lock:
-                self._replicas[name] = current
-                if changed:
-                    self._version += 1
+                stale = self._app_gen.get(name, 0) != gens.get(name, 0) or name not in self._apps
+                if not stale:
+                    self._replicas[name] = current
+                    if changed:
+                        self._version += 1
+            if stale:
+                # The app was redeployed/deleted mid-pass: our replicas run
+                # outdated code — tear them down instead of publishing them.
+                for r in created:
+                    try:
+                        api.kill(r)
+                    except Exception:
+                        pass
 
     def _control_loop(self) -> None:
         while not self._stop.wait(0.25):

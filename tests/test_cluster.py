@@ -212,3 +212,105 @@ def test_placement_group_spread_across_nodes(two_node):
     from ray_tpu.core.placement_group import remove_placement_group
 
     remove_placement_group(pg)
+
+
+def test_placement_group_enforced_and_durable(two_node):
+    """Bundle pinning is enforced for tasks and actors, and the reservation
+    survives raylet heartbeats (it lives on the raylet, not the GCS view)."""
+    rt, cluster, node2 = two_node
+    from ray_tpu.core.placement_group import (
+        PlacementGroupSchedulingStrategy,
+        placement_group,
+        remove_placement_group,
+    )
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=10)
+
+    # Reservation durability: two heartbeat periods later the cluster view
+    # still shows the bundles debited (head 1-1=0 CPU, node2 2-1=1 CPU).
+    time.sleep(2.5)
+    assert rt.available_resources().get("CPU", 0) == pytest.approx(1.0)
+
+    @rt.remote
+    def where():
+        from ray_tpu.core import runtime_base
+
+        return runtime_base.current_runtime().node_id()
+
+    # Tasks pin to their bundle's node.
+    refs = [
+        where.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=i
+            )
+        ).remote()
+        for i in range(2)
+    ]
+    nodes = rt.get(refs, timeout=60)
+    assert nodes[0] == pg.bundle_placements[0]
+    assert nodes[1] == pg.bundle_placements[1]
+
+    # Actors pin to their bundle's node (the WorkerGroup per-rank pattern).
+    @rt.remote
+    class WhereActor:
+        def node(self):
+            from ray_tpu.core import runtime_base
+
+            return runtime_base.current_runtime().node_id()
+
+    actors = [
+        WhereActor.options(
+            num_cpus=1,
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=i
+            ),
+        ).remote()
+        for i in range(2)
+    ]
+    anodes = rt.get([a.node.remote() for a in actors], timeout=60)
+    assert anodes[0] == pg.bundle_placements[0]
+    assert anodes[1] == pg.bundle_placements[1]
+
+    for a in actors:
+        rt.kill(a)
+    remove_placement_group(pg)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if rt.available_resources().get("CPU", 0) == pytest.approx(3.0):
+            break
+        time.sleep(0.2)
+    assert rt.available_resources().get("CPU", 0) == pytest.approx(3.0)
+
+
+def test_removed_pg_task_fails_fast(cluster_rt):
+    """A task pinned to a removed placement group raises instead of
+    hanging (reference: Ray fails tasks of removed PGs)."""
+    rt = cluster_rt
+    from ray_tpu.core.placement_group import (
+        PlacementGroupSchedulingStrategy,
+        placement_group,
+        remove_placement_group,
+    )
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=10)
+    strat = PlacementGroupSchedulingStrategy(placement_group=pg, placement_group_bundle_index=0)
+
+    @rt.remote
+    def oversize():
+        return 1
+
+    # Request exceeding the bundle's whole reservation fails fast.
+    with pytest.raises(Exception, match="only reserves"):
+        rt.get(oversize.options(num_cpus=2, scheduling_strategy=strat).remote(), timeout=30)
+
+    remove_placement_group(pg)
+    time.sleep(0.2)
+
+    @rt.remote
+    def pinned():
+        return 2
+
+    with pytest.raises(Exception, match="not\\b.*(reserved|schedulable)|removed"):
+        rt.get(pinned.options(scheduling_strategy=strat).remote(), timeout=30)
