@@ -28,6 +28,9 @@ class ScalingConfig:
     mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
+    # Multi-host runtime rendezvous; None with num_workers>1 uses defaults
+    # (loopback coordinator — the emulated-cluster / single-machine case).
+    backend: Optional[Any] = None  # JaxBackendConfig
 
     @property
     def total_workers(self) -> int:

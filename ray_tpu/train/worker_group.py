@@ -43,6 +43,32 @@ class _TrainWorker:
         self._mesh = build_mesh(axis_sizes=mesh_axes) if mesh_axes else build_mesh()
         return {"devices": int(self._mesh.devices.size)}
 
+    def setup_distributed(
+        self,
+        coordinator: str,
+        mesh_spec,
+        platform=None,
+        devices_per_worker=None,
+    ):
+        """Multi-host backend setup: jax.distributed rendezvous, then the
+        GLOBAL mesh over all hosts' devices (the analogue of
+        _setup_torch_process_group, reference: train/torch/config.py:66).
+        The mesh spec resolves against the global device count, which only
+        this worker (post-rendezvous) knows."""
+        from ..parallel.mesh import build_mesh
+        from .backend import setup_jax_distributed
+
+        info = setup_jax_distributed(
+            self.rank,
+            self.world_size,
+            coordinator,
+            platform=platform,
+            devices_per_worker=devices_per_worker,
+        )
+        self._mesh = build_mesh(mesh_spec)
+        info["mesh_devices"] = int(self._mesh.devices.size)
+        return info
+
     def start_training(
         self,
         fn_blob: bytes,
