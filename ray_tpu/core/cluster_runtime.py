@@ -367,11 +367,13 @@ class Cluster:
         object_store_memory: Optional[int] = None,
         num_workers: Optional[int] = None,
     ):
+        from ..utils.config import CONFIG
+
         self.session_dir = tempfile.mkdtemp(prefix="ray_tpu_session_")
         self.gcs_sock = os.path.join(self.session_dir, "gcs.sock")
         self._procs: List[subprocess.Popen] = []
         self._node_procs: Dict[str, subprocess.Popen] = {}
-        self._store_capacity = int(object_store_memory or (256 << 20))
+        self._store_capacity = int(object_store_memory or CONFIG.object_store_memory)
 
         gcs_proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.gcs", self.gcs_sock],

@@ -21,7 +21,9 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-HEARTBEAT_TIMEOUT_S = 5.0
+from ..utils.config import CONFIG
+
+HEARTBEAT_TIMEOUT_S = CONFIG.heartbeat_timeout_s
 
 
 class GcsService:
@@ -438,13 +440,18 @@ class GcsService:
     def _raylet_call(self, sock: str, method: str, *args):
         """Cached per-raylet client for control-plane calls (bundle
         lease/release, view refresh) — never on the task fast path. Entries
-        are evicted when their node dies (_on_node_death)."""
+        are evicted when their node dies (_on_node_death), so cache access
+        holds _lock; only the blocking connect stays outside it."""
         from .rpc import RpcClient
 
-        cli = self._raylet_clients.get(sock)
+        with self._lock:
+            cli = self._raylet_clients.get(sock)
         if cli is None:
-            cli = RpcClient(sock)
-            self._raylet_clients[sock] = cli
+            fresh = RpcClient(sock)
+            with self._lock:
+                cli = self._raylet_clients.setdefault(sock, fresh)
+            if cli is not fresh:
+                fresh.close()  # lost the insert race
         return cli.call(method, *args)
 
     def remove_placement_group(self, pg_id: str) -> bool:

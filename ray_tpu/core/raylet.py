@@ -26,12 +26,13 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ..utils.config import CONFIG
 from .ids import ObjectID
 from .object_transport import StoredError
 from .rpc import RpcClient, RpcServer
 from .shm_store import SharedMemoryStore
 
-POLL_TIMEOUT_S = 30.0
+POLL_TIMEOUT_S = CONFIG.worker_poll_timeout_s
 
 
 class _Worker:
@@ -227,7 +228,7 @@ class RayletService:
             if not self._fits_total(resources):
                 # The GCS resource view lags by one heartbeat; a busy-now
                 # node may free up, so retry placement before failing.
-                deadline = time.monotonic() + 10.0
+                deadline = time.monotonic() + CONFIG.placement_retry_timeout_s
                 target = None
                 while target is None:
                     target = self.gcs.call("pick_node", resources, [self.node_id])
@@ -528,7 +529,7 @@ class RayletService:
         """Detects worker-process death; fails in-flight work and drives the
         actor restart state machine (reference: node_manager worker-failure
         handling + gcs_actor_manager.h:548)."""
-        while not self._stop.wait(0.2):
+        while not self._stop.wait(CONFIG.worker_monitor_interval_s):
             dead: List[_Worker] = []
             with self._workers_lock:
                 for w in list(self._workers.values()):
@@ -588,7 +589,7 @@ class RayletService:
 
     # ---------------------------------------------------------- lifecycle
     def _heartbeat_loop(self) -> None:
-        while not self._stop.wait(1.0):
+        while not self._stop.wait(CONFIG.heartbeat_interval_s):
             with self._res_lock:
                 avail = dict(self.available)
             try:

@@ -300,10 +300,19 @@ class Dataset:
                     issued.append(out)
                     yield out
             finally:
-                try:
-                    api.wait(issued, num_returns=len(issued), timeout=60)
-                except Exception:
-                    pass
+                # Poll until every issued ref has resolved — no hard cap: a
+                # slow tail UDF must not get its worker killed while refs
+                # already yielded downstream are still computing. Progress
+                # is guaranteed (each wait round either resolves refs or the
+                # actor died, which also resolves them with an error).
+                pending = list(issued)
+                while pending:
+                    try:
+                        _, pending = api.wait(
+                            pending, num_returns=len(pending), timeout=5
+                        )
+                    except Exception:
+                        break
                 for a in actors:
                     try:
                         api.kill(a)

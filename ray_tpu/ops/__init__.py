@@ -1,0 +1,6 @@
+"""Fused TPU kernels (pallas). The hot single-chip ops live here; the
+model layer picks them up via config (models/transformer.py attn_impl)."""
+
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -122,10 +122,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, 
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, block_q, block_k, num_q):
-    ik, iq = pl.program_id(1), pl.program_id(2)
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, block_q, block_k, num_q, rep):
+    """Grid: (b*h_kv, nk, rep*num_q) — the innermost axis walks every
+    (shared-q-head, q-block) pair contributing to this kv head, so GQA's
+    sum over the `rep` query heads happens in VMEM scratch instead of
+    materializing repeated K/V in HBM."""
+    ik, t = pl.program_id(1), pl.program_id(2)
+    iq = t % num_q
 
-    @pl.when(iq == 0)
+    @pl.when(t == 0)
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -150,7 +155,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         ds = p * (dp - delta_ref[0]) * scale
         dk_scr[:] += _dot(ds.astype(q.dtype), q, contract=((0,), (0,)))
 
-    @pl.when(iq == num_q - 1)
+    @pl.when(t == rep * num_q - 1)
     def _():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
@@ -182,25 +187,39 @@ def _pick_block(s: int, want: int) -> Optional[int]:
     return None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    o, _ = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret, heads):
+    o, _ = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret, heads)
     return o
 
 
-def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
+def _kv_index(h: int, h_kv: int):
+    """Maps the q-side grid index bh = batch*h + head to the kv-side row
+    batch*h_kv + head // rep — GQA head sharing resolved by the BlockSpec
+    index map, so repeated K/V never materialize."""
+    rep = h // h_kv
+
+    def f(b, i, j):
+        return ((b // h) * h_kv + (b % h) // rep, j, 0)
+
+    return f
+
+
+def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret, heads):
+    h, h_kv = heads
     bh, s, d = q.shape
     nq, nk = s // block_q, s // block_k
     kern = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k, num_k=nk
     )
+    kv_map = _kv_index(h, h_kv)
     o, lse = pl.pallas_call(
         kern,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -211,33 +230,35 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
         ],
         scratch_shapes=[
-            _scratch((block_q, 128), jnp.float32, interpret),
-            _scratch((block_q, 128), jnp.float32, interpret),
-            _scratch((block_q, d), jnp.float32, interpret),
+            _scratch((block_q, 128), jnp.float32),
+            _scratch((block_q, 128), jnp.float32),
+            _scratch((block_q, d), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
     return o, lse
 
 
-def _scratch(shape, dtype, interpret):
-    if pltpu is not None and not interpret:
-        return pltpu.VMEM(shape, dtype)
-    if pltpu is not None:
-        return pltpu.VMEM(shape, dtype)  # interpreter accepts VMEM scratch
-    raise RuntimeError("pallas TPU backend unavailable")
+def _scratch(shape, dtype):
+    if pltpu is None:
+        raise RuntimeError("pallas TPU backend unavailable")
+    return pltpu.VMEM(shape, dtype)  # the interpreter accepts VMEM scratch too
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    o, lse = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret, heads):
+    o, lse = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret, heads)
     return o, (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, do):
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, heads, res, do):
     q, k, v, o, lse = res
+    h, h_kv = heads
+    rep = h // h_kv
     bh, s, d = q.shape
+    bh_kv = k.shape[0]
     nq, nk = s // block_q, s // block_k
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # [bh, s, 1]
+    kv_map = _kv_index(h, h_kv)
 
     dq = pl.pallas_call(
         functools.partial(
@@ -246,42 +267,51 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, do):
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-        scratch_shapes=[_scratch((block_q, d), jnp.float32, interpret)],
+        scratch_shapes=[_scratch((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
+    # dk/dv walk the kv-side batch axis; the q/do/lse/delta index maps fan
+    # the rep query heads sharing each kv head through the inner grid axis.
+    def q_map(b, j, t):
+        return ((b // h_kv) * h + (b % h_kv) * rep + t // nq, t % nq, 0)
+
+    def k_map(b, j, t):
+        return (b, j, 0)
+
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k, num_q=nq
+            _dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, num_q=nq, rep=rep,
         ),
-        grid=(bh, nk, nq),
+        grid=(bh_kv, nk, rep * nq),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_k, d), k_map),
+            pl.BlockSpec((1, block_k, d), k_map),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_q, 1), q_map),
+            pl.BlockSpec((1, block_q, 1), q_map),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), k_map),
+            pl.BlockSpec((1, block_k, d), k_map),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+            jax.ShapeDtypeStruct((bh_kv, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh_kv, s, d), v.dtype),
         ],
         scratch_shapes=[
-            _scratch((block_k, d), jnp.float32, interpret),
-            _scratch((block_k, d), jnp.float32, interpret),
+            _scratch((block_k, d), jnp.float32),
+            _scratch((block_k, d), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -309,10 +339,9 @@ def flash_attention(
     to True off-TPU so the same kernel runs (slowly) on CPU for tests.
     """
     b, s, h, d = q.shape
-    if k.shape[2] != h:  # GQA: expand kv heads to q heads
-        rep = h // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"n_heads {h} not divisible by n_kv_heads {h_kv}")
     scale = scale if scale is not None else d**-0.5
     if interpret is None:
         interpret = _auto_interpret()
@@ -320,10 +349,14 @@ def flash_attention(
     if pltpu is None or bq is None or bk is None:
         from ..parallel.ring_attention import attention_reference
 
+        if h_kv != h:  # the unfused path wants expanded kv heads
+            k = jnp.repeat(k, h // h_kv, axis=2)
+            v = jnp.repeat(v, h // h_kv, axis=2)
         return attention_reference(q, k, v, causal=causal, scale=scale)
 
     def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+        hh = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * hh, s, d)
 
-    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, scale, bq, bk, interpret)
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, scale, bq, bk, interpret, (h, h_kv))
     return o.reshape(b, h, s, d).transpose(0, 2, 1, 3)

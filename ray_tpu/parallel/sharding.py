@@ -121,12 +121,28 @@ def batch_sharding(mesh: Mesh, *, seq: bool = False) -> NamedSharding:
 
 
 def shard_batch(batch: PyTree, mesh: Mesh, *, seq: bool = False) -> PyTree:
-    """Shards host arrays of a batch over (data, fsdp)[, seq]."""
+    """Shards host arrays of a batch over (data, fsdp)[, seq].
+
+    Single-process meshes use device_put. When the mesh spans processes
+    (multi-host SPMD), each host passes ITS shard of the global batch and
+    the leaves assemble into global arrays via
+    `jax.make_array_from_process_local_data` — the host-array analogue of
+    the reference handing each DDP rank its sampler shard."""
+    n_proc = len({d.process_index for d in mesh.devices.flat})
+    multiprocess = n_proc > 1
 
     def one(leaf):
-        spec = _clamp_spec(
-            BATCH_SEQ_SPEC if seq else BATCH_SPEC, tuple(leaf.shape), mesh
-        )
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
+        shape = tuple(leaf.shape)
+        if multiprocess:
+            # Each host holds 1/n_proc of the global batch; divisibility of
+            # the sharded batch dim must be judged against the GLOBAL shape.
+            shape = (shape[0] * n_proc,) + shape[1:]
+        spec = _clamp_spec(BATCH_SEQ_SPEC if seq else BATCH_SPEC, shape, mesh)
+        sharding = NamedSharding(mesh, spec)
+        if multiprocess:
+            import numpy as np
+
+            return jax.make_array_from_process_local_data(sharding, np.asarray(leaf))
+        return jax.device_put(leaf, sharding)
 
     return jax.tree_util.tree_map(one, batch)

@@ -50,6 +50,15 @@ class JaxLearner:
         self.tx = tx
         self.params = module.init_params(jax.random.PRNGKey(seed))
         self.opt_state = tx.init(self.params)
+        if mesh is not None:
+            # Commit params/opt-state as (replicated) global arrays on the
+            # mesh — required for multi-process SPMD, harmless single-host
+            # (init is seed-deterministic, so every process places the same
+            # values).
+            from ..parallel.sharding import replicated
+
+            self.params = jax.device_put(self.params, replicated(mesh))
+            self.opt_state = jax.device_put(self.opt_state, replicated(mesh))
 
         def _update(params, opt_state, batch):
             (loss, metrics), grads = jax.value_and_grad(
@@ -78,6 +87,10 @@ class JaxLearner:
         return jax.device_get(self.params)
 
     def set_weights(self, params: PyTree) -> bool:
+        if self.mesh is not None:
+            from ..parallel.sharding import replicated
+
+            params = jax.device_put(params, replicated(self.mesh))
         self.params = params
         return True
 
@@ -94,11 +107,80 @@ class JaxLearner:
         self.opt_state = self.tx.init(self.params)
 
 
+class _DistributedLearner:
+    """Actor body: one process of a multi-host learner gang. Each actor
+    rendezvouses via jax.distributed and runs the SAME jitted update over
+    the shared global mesh — the gradient psum rides the mesh's data axis
+    (the TPU inversion of the reference's BackendExecutor-bootstrapped
+    NCCL DDP, learner_group.py:55-68)."""
+
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+        self._learner: Optional[JaxLearner] = None
+
+    def setup(
+        self,
+        coordinator: str,
+        platform: Optional[str],
+        devices_per_learner: Optional[int],
+        module_blob: bytes,
+        loss_blob: bytes,
+        lr: float,
+        grad_clip: Optional[float],
+        seed: int,
+        init_timeout_s: float = 60.0,
+    ):
+        import cloudpickle
+
+        from ..train.backend import setup_jax_distributed
+
+        info = setup_jax_distributed(
+            self.rank,
+            self.world_size,
+            coordinator,
+            platform=platform,
+            devices_per_worker=devices_per_learner,
+            init_timeout_s=init_timeout_s,
+        )
+        from ..parallel.mesh import MeshSpec, build_mesh
+
+        mesh = build_mesh(MeshSpec(data=-1))
+        self._learner = JaxLearner(
+            cloudpickle.loads(module_blob),
+            cloudpickle.loads(loss_blob),
+            lr=lr,
+            grad_clip=grad_clip,
+            seed=seed,
+            mesh=mesh,
+        )
+        return info
+
+    def update(self, shard: Dict[str, np.ndarray]) -> Dict[str, float]:
+        return self._learner.update(shard)
+
+    def get_weights(self) -> PyTree:
+        return self._learner.get_weights()
+
+    def set_weights(self, params: PyTree) -> bool:
+        return self._learner.set_weights(params)
+
+    def save_state(self, directory: str) -> bool:
+        self._learner.save_state(directory)
+        return True
+
+    def load_state(self, directory: str) -> bool:
+        self._learner.load_state(directory)
+        return True
+
+
 class LearnerGroup:
     """Learner actors behind one update() call (reference:
-    learner_group.py:81). With n_learners=1 the learner still spans all
-    local devices through its mesh (DP/FSDP inside the program); multiple
-    learner actors map to multiple hosts."""
+    learner_group.py:81). With num_learners=1 the learner runs in-process
+    and still spans all local devices through its mesh (DP/FSDP inside the
+    program). num_learners>1 spawns one actor PROCESS per learner; the gang
+    rendezvouses into one jax.distributed world and every update is one
+    SPMD program over the global mesh."""
 
     def __init__(
         self,
@@ -110,31 +192,122 @@ class LearnerGroup:
         grad_clip: Optional[float] = 0.5,
         seed: int = 0,
         use_mesh: bool = False,
+        devices_per_learner: Optional[int] = None,
+        platform: Optional[str] = None,
+        coordinator_host: Optional[str] = None,
     ):
-        if num_learners != 1:
-            # Multiple learner ACTORS are the multi-host path and require
-            # cross-process gradient averaging, which arrives with the
-            # distributed runtime. Refusing beats silently training
-            # divergent replicas. Multi-DEVICE scaling already works: the
-            # single learner's mesh spans all local chips (DP in-program).
-            raise NotImplementedError(
-                "num_learners > 1 requires the multi-host runtime; "
-                "use use_mesh=True to scale over local devices"
-            )
-        mesh = None
-        if use_mesh:
-            from ..parallel.mesh import MeshSpec, build_mesh
+        self.num_learners = num_learners
+        self._actors = None
+        self._learner = None
+        if num_learners <= 1:
+            mesh = None
+            if use_mesh:
+                from ..parallel.mesh import MeshSpec, build_mesh
 
-            mesh = build_mesh(MeshSpec(data=-1))
-        self._learner = JaxLearner(
-            module, loss_fn, lr=lr, grad_clip=grad_clip, seed=seed, mesh=mesh
+                mesh = build_mesh(MeshSpec(data=-1))
+            self._learner = JaxLearner(
+                module, loss_fn, lr=lr, grad_clip=grad_clip, seed=seed, mesh=mesh
+            )
+            return
+
+        import os
+
+        import cloudpickle
+
+        from .. import api
+        from ..core import runtime_base
+        from ..core.local_runtime import LocalRuntime
+        from ..train.backend import free_port
+
+        if isinstance(runtime_base.current_runtime(), LocalRuntime):
+            raise RuntimeError(
+                "num_learners>1 needs process-isolated learner actors; "
+                "initialize the cluster runtime (ray_tpu.init()) instead of "
+                "local_mode=True"
+            )
+        platform = platform or os.environ.get("RAY_TPU_PLATFORM")
+        host = coordinator_host or "127.0.0.1"
+        coord = f"{host}:{free_port()}"
+        actor_cls = api.remote(num_cpus=1)(_DistributedLearner)
+        self._actors = [actor_cls.remote(i, num_learners) for i in range(num_learners)]
+        infos = api.get(
+            [
+                a.setup.remote(
+                    coord,
+                    platform,
+                    devices_per_learner,
+                    cloudpickle.dumps(module),
+                    cloudpickle.dumps(loss_fn),
+                    lr,
+                    grad_clip,
+                    seed,
+                )
+                for a in self._actors
+            ]
         )
+        self._global_devices = int(infos[0]["global_devices"])
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
-        return self._learner.update(batch)
+        if self._actors is None:
+            return self._learner.update(batch)
+        from .. import api
+
+        n = self.num_learners
+        B = len(next(iter(batch.values())))
+        # Every process must contribute an equal, device-divisible shard
+        # (gloo/ICI collectives are gang-wide); trim the ragged tail.
+        usable = B - (B % self._global_devices)
+        if usable == 0:
+            raise ValueError(
+                f"batch of {B} rows is smaller than the {self._global_devices}"
+                "-device gang; enlarge the batch or reduce learners"
+            )
+        per = usable // n
+        refs = [
+            a.update.remote({k: v[i * per : (i + 1) * per] for k, v in batch.items()})
+            for i, a in enumerate(self._actors)
+        ]
+        out = api.get(refs)
+        return out[0]
 
     def get_weights(self) -> PyTree:
-        return self._learner.get_weights()
+        if self._actors is None:
+            return self._learner.get_weights()
+        from .. import api
+
+        return api.get(self._actors[0].get_weights.remote())
 
     def set_weights(self, params: PyTree) -> None:
-        self._learner.set_weights(params)
+        if self._actors is None:
+            self._learner.set_weights(params)
+            return
+        from .. import api
+
+        api.get([a.set_weights.remote(params) for a in self._actors])
+
+    def save_state(self, directory: str) -> None:
+        if self._actors is None:
+            self._learner.save_state(directory)
+        else:
+            from .. import api
+
+            api.get(self._actors[0].save_state.remote(directory))
+
+    def load_state(self, directory: str) -> None:
+        if self._actors is None:
+            self._learner.load_state(directory)
+        else:
+            from .. import api
+
+            api.get([a.load_state.remote(directory) for a in self._actors])
+
+    def shutdown(self) -> None:
+        if self._actors:
+            from .. import api
+
+            for a in self._actors:
+                try:
+                    api.kill(a)
+                except Exception:
+                    pass
+            self._actors = None

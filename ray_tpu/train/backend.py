@@ -57,6 +57,7 @@ def setup_jax_distributed(
     coordinator: str,
     platform: Optional[str] = None,
     devices_per_worker: Optional[int] = None,
+    init_timeout_s: float = 60.0,
 ) -> Dict[str, Any]:
     """Worker-side rendezvous. MUST run before the process initializes any
     jax backend (worker processes import jax lazily, so this holds when it
@@ -78,12 +79,18 @@ def setup_jax_distributed(
         # reliable override for processes where jax is already imported.
         jax.config.update("jax_platforms", platform)
         os.environ["RAY_TPU_PLATFORM"] = platform
+    if platform == "cpu" and world_size > 1:
+        # Cross-process collectives on the host platform go through gloo
+        # (the emulation analogue of ICI; the reference's CPU fallback is
+        # GLOOGroup, gloo_collective_group.py:184).
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
     if world_size > 1:
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=world_size,
             process_id=rank,
+            initialization_timeout=int(init_timeout_s),
         )
     return {
         "process_index": jax.process_index(),

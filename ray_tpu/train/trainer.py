@@ -125,6 +125,18 @@ class JaxTrainer:
             error=last_error,
         )
 
+    def _use_distributed(self) -> bool:
+        """Multi-host rendezvous requires process-isolated workers (one jax
+        runtime per worker); the thread-based local runtime shares one
+        process, so it keeps the local-mesh path."""
+        sc = self.scaling_config
+        if sc.backend is None and sc.num_workers <= 1:
+            return False
+        from ..core import runtime_base
+        from ..core.local_runtime import LocalRuntime
+
+        return not isinstance(runtime_base.current_runtime(), LocalRuntime)
+
     # ---------------------------------------------------------------- inner
     def _run_attempt(
         self,
@@ -147,12 +159,41 @@ class JaxTrainer:
         )
         self._last_metrics: Dict[str, Any] = {}
         try:
-            # Backend setup: every worker builds its mesh (the analogue of
-            # _setup_torch_process_group, reference: torch/config.py:66).
-            from ..parallel.mesh import default_devices
+            # Backend setup (the analogue of _setup_torch_process_group,
+            # reference: train/_internal/backend_executor.py:135 start ->
+            # Backend.on_start, torch/config.py:66). Two paths:
+            #  - multi-host (cluster runtime, num_workers>1 or an explicit
+            #    backend config): every worker-process rendezvouses via
+            #    jax.distributed.initialize and builds the GLOBAL mesh;
+            #  - single host: each worker builds the local-device mesh.
+            if self._use_distributed():
+                import os
 
-            mesh_axes = sc.mesh.resolve(len(default_devices()))
-            api.get([w.setup_mesh.remote(mesh_axes) for w in group.workers])
+                from .backend import JaxBackendConfig, coordinator_address
+
+                cfg = sc.backend or JaxBackendConfig()
+                if cfg.platform is None and os.environ.get("RAY_TPU_PLATFORM"):
+                    cfg = dataclasses.replace(
+                        cfg, platform=os.environ["RAY_TPU_PLATFORM"]
+                    )
+                coord = coordinator_address(cfg)
+                api.get(
+                    [
+                        w.setup_distributed.remote(
+                            coord,
+                            sc.mesh,
+                            cfg.platform,
+                            cfg.devices_per_worker,
+                            cfg.init_timeout_s,
+                        )
+                        for w in group.workers
+                    ]
+                )
+            else:
+                from ..parallel.mesh import default_devices
+
+                mesh_axes = sc.mesh.resolve(len(default_devices()))
+                api.get([w.setup_mesh.remote(mesh_axes) for w in group.workers])
 
             blob = cloudpickle.dumps(self._train_loop)
             config = dict(self._config)

@@ -49,6 +49,7 @@ class _TrainWorker:
         mesh_spec,
         platform=None,
         devices_per_worker=None,
+        init_timeout_s: float = 60.0,
     ):
         """Multi-host backend setup: jax.distributed rendezvous, then the
         GLOBAL mesh over all hosts' devices (the analogue of
@@ -64,6 +65,7 @@ class _TrainWorker:
             coordinator,
             platform=platform,
             devices_per_worker=devices_per_worker,
+            init_timeout_s=init_timeout_s,
         )
         self._mesh = build_mesh(mesh_spec)
         info["mesh_devices"] = int(self._mesh.devices.size)

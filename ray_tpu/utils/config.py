@@ -1,0 +1,80 @@
+"""Runtime flag system: env-overridable tunables with typed defaults.
+
+Re-design of the reference's RAY_CONFIG table (reference:
+src/ray/common/ray_config.h:60, the 218-entry macro table in
+ray_config_def.h, overridable via RAY_<name> env vars). Same contract
+here: every timing/size constant the runtime daemons use is declared once
+with a default and can be overridden with `RAY_TPU_<NAME>` in the
+environment of the process that reads it (daemons inherit the driver's
+environment, so exporting before `init()` reaches the whole cluster).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Union
+
+_REGISTRY: Dict[str, Union[float, int, str, bool]] = {}
+
+
+def _declare(name: str, default):
+    """Reads RAY_TPU_<NAME> from the environment, coerced to the default's
+    type; registers the flag so `all_flags()` can report effective values."""
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    value = default
+    if raw is not None:
+        kind = type(default)
+        if kind is bool:
+            value = raw.lower() in ("1", "true", "yes", "on")
+        else:
+            value = kind(raw)
+    _REGISTRY[name] = value
+    return value
+
+
+def all_flags() -> Dict[str, Union[float, int, str, bool]]:
+    """Effective flag values (post env override) for debugging/state API."""
+    return dict(_REGISTRY)
+
+
+class RayTpuConfig:
+    """The flag table. Class attributes are resolved once at import, like
+    the reference's process-lifetime RayConfig singleton."""
+
+    # --- health / liveness -------------------------------------------------
+    # Raylet -> GCS heartbeat period (reference: raylet_heartbeat_period_ms).
+    heartbeat_interval_s: float = _declare("heartbeat_interval_s", 1.0)
+    # GCS declares a node dead after this silence (reference:
+    # health_check_timeout_ms).
+    heartbeat_timeout_s: float = _declare("heartbeat_timeout_s", 5.0)
+    # Raylet worker-death monitor poll period.
+    worker_monitor_interval_s: float = _declare("worker_monitor_interval_s", 0.2)
+
+    # --- worker pool -------------------------------------------------------
+    # Worker long-poll duration before an empty-mailbox round trip.
+    worker_poll_timeout_s: float = _declare("worker_poll_timeout_s", 30.0)
+    # Idle workers kept per runtime-env key beyond the CPU count.
+    idle_workers_per_env: int = _declare("idle_workers_per_env", 2)
+
+    # --- object store ------------------------------------------------------
+    # Default per-node shared-memory pool size.
+    object_store_memory: int = _declare("object_store_memory", 256 << 20)
+    # Chunk size for node-to-node object transfer (reference:
+    # object_manager_default_chunk_size).
+    transfer_chunk_bytes: int = _declare("transfer_chunk_bytes", 8 << 20)
+    # Pool-usage fraction above which the raylet spills sealed objects.
+    spill_threshold: float = _declare("spill_threshold", 0.8)
+
+    # --- scheduling --------------------------------------------------------
+    # How long a raylet retries cluster placement before failing a task
+    # no node can currently satisfy.
+    placement_retry_timeout_s: float = _declare("placement_retry_timeout_s", 10.0)
+    # Long-poll duration for object-location waits (pubsub stand-in).
+    object_wait_poll_s: float = _declare("object_wait_poll_s", 10.0)
+
+    # --- GCS ---------------------------------------------------------------
+    # Periodic snapshot interval for GCS table persistence (0 = every write).
+    gcs_snapshot_interval_s: float = _declare("gcs_snapshot_interval_s", 1.0)
+
+
+CONFIG = RayTpuConfig()
