@@ -158,7 +158,9 @@ def _build_sched_options(opts: Dict[str, Any]) -> SchedulingOptions:
         ),
         placement_group_id=pg_id,
         bundle_index=bundle_index,
-        max_retries=opts.get("max_retries", opts.get("max_task_retries", 0)) or 0,
+        # Tasks default to 3 system-failure retries like the reference
+        # (python/ray/remote_function.py DEFAULT_TASK_MAX_RETRIES).
+        max_retries=opts.get("max_retries", opts.get("max_task_retries", 3)) or 0,
         retry_exceptions=bool(opts.get("retry_exceptions", False)),
         scheduling_strategy=strategy if isinstance(strategy, str) else "DEFAULT",
         max_concurrency=opts.get("max_concurrency", 1),

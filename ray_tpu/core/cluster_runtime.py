@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import cloudpickle
 
 from .. import exceptions as exc
+from ..utils.config import CONFIG
 from .ids import ActorID, ObjectID, TaskID
 from .object_transport import StoredError
 from .rpc import RpcClient
@@ -58,12 +59,29 @@ def _entry_from_spec(spec: TaskSpec) -> dict:
         "resources": resources,
         "actor_id": spec.actor_id.hex() if spec.actor_id else None,
         "max_restarts": spec.options.max_restarts,
+        "max_retries": spec.options.max_retries,
+        "attempt": 0,
         "pg_id": spec.options.placement_group_id,
         "bundle_index": spec.options.bundle_index,
         "name": spec.options.name,
         "namespace": spec.options.namespace,
         "desc": spec.description(),
     }
+
+
+class _TaskRecord:
+    """Owner-side record of a submitted task: the wire entry kept for retry
+    and lineage reconstruction until the last reference to its outputs drops
+    (reference: task_manager.h:208 — the lineage half :388-402)."""
+
+    __slots__ = ("entry", "kind", "attempts", "last_submit", "lock")
+
+    def __init__(self, entry: dict, kind: str):
+        self.entry = entry
+        self.kind = kind  # "task" | "actor_task"
+        self.attempts = 0
+        self.last_submit = time.monotonic()
+        self.lock = threading.Lock()
 
 
 class ClusterRuntime(Runtime):
@@ -87,6 +105,22 @@ class ClusterRuntime(Runtime):
         self._actor_location: Dict[str, str] = {}  # actor_id -> raylet sock
         self._raylet_clients: Dict[str, RpcClient] = {}
         self._shutdown_done = False
+        # Owner-side reference counting + task records (reference:
+        # reference_count.h:64, task_manager.h:208). return-oid hex ->
+        # shared _TaskRecord; pruned when the last local ref to any of the
+        # task's outputs drops.
+        self._ref_lock = threading.Lock()
+        self._local_refs: Dict[str, int] = {}
+        self._owned: set = set()  # oids this process created (put / submit)
+        self._records: Dict[str, _TaskRecord] = {}
+        self._pending_free: List[str] = []
+        self._borrow_buf: Dict[str, int] = {}
+        self._dropped_records: List[_TaskRecord] = []
+        self._free_wake = threading.Event()
+        self._free_thread = threading.Thread(
+            target=self._free_loop, daemon=True, name="free"
+        )
+        self._free_thread.start()
 
     # ------------------------------------------------------------ factory
     @classmethod
@@ -140,27 +174,162 @@ class ClusterRuntime(Runtime):
             driver=driver,
         )
 
+    # ----------------------------------------------------- reference count
+    def add_local_ref(self, object_id: ObjectID) -> None:
+        h = object_id.hex()
+        borrowed = False
+        with self._ref_lock:
+            c = self._local_refs.get(h, 0)
+            self._local_refs[h] = c + 1
+            if c == 0 and h not in self._owned:
+                # First ref to an object this process does not own: register
+                # a borrow with the GCS so the owner's free is deferred
+                # (reference: reference_count.h borrower protocol).
+                self._borrow_buf[h] = self._borrow_buf.get(h, 0) + 1
+                borrowed = True
+        if borrowed:
+            self._free_wake.set()
+
+    def remove_local_ref(self, object_id: ObjectID) -> None:
+        freed = False
+        with self._ref_lock:
+            # Iterative cascade: freeing an output releases its task's
+            # lineage pins on the deps, which may free those in turn
+            # (reference: reference_count.h lineage pinning).
+            work = [object_id.hex()]
+            while work:
+                h = work.pop()
+                c = self._local_refs.get(h, 0) - 1
+                if c > 0:
+                    self._local_refs[h] = c
+                    continue
+                self._local_refs.pop(h, None)
+                if h not in self._owned:
+                    # Borrowed ref fully dropped here: return the borrow.
+                    self._borrow_buf[h] = self._borrow_buf.get(h, 0) - 1
+                    freed = True
+                    continue
+                self._owned.discard(h)
+                rec = self._records.pop(h, None)
+                self._pending_free.append(h)
+                freed = True
+                if rec is not None and not any(
+                    self._records.get(r) is rec for r in rec.entry["return_ids"]
+                ):
+                    # Last output ref dropped. The task may still be in
+                    # flight (fire-and-forget), so its argument pins are
+                    # released by the free loop only once the task reaches a
+                    # terminal state (flight-time pinning, reference:
+                    # reference_count.h submitted-task count).
+                    if rec.entry.get("deps"):
+                        self._dropped_records.append(rec)
+        if freed:
+            self._free_wake.set()
+
+    def _release_dropped_records(self) -> None:
+        """Releases argument pins of fully-dropped tasks that have finished
+        (called from the free loop, no locks held)."""
+        with self._ref_lock:
+            pending, self._dropped_records = self._dropped_records, []
+        if not pending:
+            return
+        keep: List[_TaskRecord] = []
+        try:
+            states = self._gcs.call(
+                "get_task_states", [r.entry["task_id"] for r in pending]
+            )
+        except Exception:
+            with self._ref_lock:
+                self._dropped_records.extend(pending)
+            return
+        now = time.monotonic()
+        for rec in pending:
+            st = states.get(rec.entry["task_id"])
+            terminal = st is not None and st["state"] in ("FINISHED", "FAILED")
+            # Unknown state: either evicted (long terminal) or never reported
+            # (raylet died); treat as terminal after a grace period.
+            aged_out = st is None and now - rec.last_submit > 2 * CONFIG.heartbeat_timeout_s
+            if terminal or aged_out:
+                for dep in rec.entry.get("deps", []):
+                    self.remove_local_ref(ObjectID.from_hex(dep))
+            else:
+                keep.append(rec)
+        if keep:
+            with self._ref_lock:
+                self._dropped_records.extend(keep)
+
+    def _free_loop(self) -> None:
+        """Batches owner releases + borrow deltas into one RPC each
+        (reference: the reference batches plasma Deletes the same way)."""
+        while not self._shutdown_done:
+            self._free_wake.wait(timeout=0.5)
+            self._free_wake.clear()
+            time.sleep(0.02)  # coalesce a burst of drops
+            self._release_dropped_records()
+            with self._ref_lock:
+                batch, self._pending_free = self._pending_free, []
+                borrows, self._borrow_buf = self._borrow_buf, {}
+            borrows = {h: d for h, d in borrows.items() if d != 0}
+            # Borrows first: a borrow must land before the owner's free does.
+            if borrows:
+                try:
+                    self._gcs.call("update_borrows", borrows)
+                except Exception:
+                    with self._ref_lock:  # GCS hiccup: retry next round
+                        for h, d in borrows.items():
+                            self._borrow_buf[h] = self._borrow_buf.get(h, 0) + d
+                    time.sleep(0.2)
+            if batch:
+                try:
+                    self._gcs.call("free_objects", batch)
+                except Exception:
+                    with self._ref_lock:
+                        self._pending_free = batch + self._pending_free
+                    time.sleep(0.2)
+
+    def _record_submission(self, entry: dict, kind: str) -> None:
+        rec = _TaskRecord(entry, kind)
+        with self._ref_lock:
+            for h in entry["return_ids"]:
+                self._records[h] = rec
+                self._owned.add(h)
+            # Lineage-pin the arguments: they stay alive (and reconstructable)
+            # while any output of this task is still referenced.
+            for dep in entry.get("deps", []):
+                self._local_refs[dep] = self._local_refs.get(dep, 0) + 1
+
     # ------------------------------------------------------------ objects
     def put(self, value: Any) -> ObjectID:
         oid = TaskID.for_task().object_id_for_return(0)
         self._store.put(oid, value)
-        self._gcs.call("add_object_location", oid.hex(), self._node_id)
+        with self._ref_lock:
+            self._owned.add(oid.hex())
+        self._raylet.call("notify_object", oid.hex())
         return oid
 
     def _get_one(self, oid: ObjectID, deadline: Optional[float]) -> Any:
+        h = oid.hex()
         while True:
             if self._store.contains(oid):
                 value = self._store.get(oid, timeout=5.0)
                 if isinstance(value, StoredError):
                     raise value.error
                 return value
-            # Not local: ask our raylet to pull it in.
             remaining = None if deadline is None else deadline - time.monotonic()
             if remaining is not None and remaining <= 0:
                 raise exc.GetTimeoutError(f"get() timed out for {oid.hex()[:12]}")
-            ok = self._raylet.call("pull_object", oid.hex(), 0.5)
-            if not ok:
-                time.sleep(0.005)
+            poll = CONFIG.object_wait_poll_s
+            if remaining is not None:
+                poll = max(0.05, min(poll, remaining))
+            # Event-driven wait on the local raylet (pulls remote copies in).
+            ready = self._raylet.call(
+                "wait_objects", [h], 1, poll, True, timeout=poll + 10.0
+            )
+            if ready:
+                continue
+            # Nothing appeared within the poll window: consult the task
+            # table for failure/loss and retry or reconstruct.
+            self._maybe_recover(oid)
 
     def get(self, object_ids: Sequence[ObjectID], timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -169,22 +338,109 @@ class ClusterRuntime(Runtime):
     def wait(self, object_ids, num_returns, timeout):
         deadline = None if timeout is None else time.monotonic() + timeout
         ids = list(object_ids)
-
-        def ready(oid: ObjectID) -> bool:
-            if self._store.contains(oid):
-                return True
-            return bool(self._gcs.call("get_object_locations", oid.hex()))
-
+        hexes = [oid.hex() for oid in ids]
         while True:
-            ready_idx = [i for i, oid in enumerate(ids) if ready(oid)]
-            if len(ready_idx) >= num_returns:
-                ready_idx = ready_idx[:num_returns]
+            remaining = None if deadline is None else deadline - time.monotonic()
+            poll = CONFIG.object_wait_poll_s
+            if remaining is not None:
+                poll = max(0.0, min(poll, remaining))
+            ready_h = set(
+                self._raylet.call(
+                    "wait_objects", hexes, num_returns, poll, False, timeout=poll + 10.0
+                )
+            )
+            if len(ready_h) >= num_returns or (
+                deadline is not None and time.monotonic() >= deadline
+            ):
                 break
-            if deadline is not None and time.monotonic() > deadline:
-                break
-            time.sleep(0.005)
+            # Straggler window expired: nudge recovery for missing objects
+            # (errors surface as stored error objects, which become ready).
+            for oid in ids:
+                if oid.hex() not in ready_h:
+                    try:
+                        self._maybe_recover(oid, store_errors=True)
+                    except Exception:
+                        pass
+        ready_idx = [i for i, h in enumerate(hexes) if h in ready_h][:num_returns]
         ready_set = set(ready_idx)
         return ready_idx, [i for i in range(len(ids)) if i not in ready_set]
+
+    # --------------------------------------------------- failure recovery
+    def _maybe_recover(self, oid: ObjectID, store_errors: bool = False) -> None:
+        """Owner-side retry/reconstruction decision for an object that has
+        not appeared (reference: object_recovery_manager.h:41 +
+        task_manager.h retries). Raises (or stores an error object when
+        `store_errors`) only when the object is provably unrecoverable."""
+        h = oid.hex()
+        rec = self._records.get(h)
+        if rec is None:
+            return  # a put / borrowed object: nothing to re-execute
+        if rec.kind != "task":
+            return  # actor task outputs surface errors via the raylet
+        with rec.lock:
+            # Throttle: give the (re)submission a full failure-detection
+            # period before acting again.
+            if time.monotonic() - rec.last_submit < CONFIG.heartbeat_timeout_s:
+                return
+            tid = rec.entry["task_id"]
+            st = self._gcs.call("get_task_states", [tid]).get(tid)
+            state = st["state"] if st else None
+            if state in ("QUEUED", "RUNNING"):
+                rec.last_submit = time.monotonic()  # alive; keep waiting
+                return
+            if self._gcs.call("get_object_locations", h):
+                return  # exists somewhere; pull is in progress
+            # FAILED(node_died), FINISHED-but-lost, or unknown (raylet died
+            # before reporting): re-execute from lineage if retries remain.
+            mr = rec.entry.get("max_retries", 0)
+            budget = float("inf") if mr < 0 else max(1, mr)
+            if mr == 0 and state != "FINISHED":
+                budget = 0  # non-retryable task that never finished
+            if rec.attempts >= budget:
+                err = exc.ObjectLostError(h)
+                if store_errors:
+                    self._store_error_object(rec.entry, err)
+                    return
+                raise err
+            rec.attempts += 1
+            rec.last_submit = time.monotonic()
+            entry = dict(rec.entry)
+            entry["attempt"] = rec.attempts
+        # Reconstruct missing dependencies first (2-deep+ lineage chains).
+        for dep in entry.get("deps", []):
+            dep_oid = ObjectID.from_hex(dep)
+            if not self._store.contains(dep_oid) and not self._gcs.call(
+                "get_object_locations", dep
+            ):
+                dep_rec = self._records.get(dep)
+                if dep_rec is not None:
+                    with dep_rec.lock:
+                        dep_rec.last_submit = 0.0  # lift throttle for cascade
+                    self._maybe_recover(dep_oid, store_errors=store_errors)
+        self._submit_entry(entry)
+
+    def _store_error_object(self, entry: dict, err: BaseException) -> None:
+        for rid in entry["return_ids"]:
+            rid_oid = ObjectID.from_hex(rid)
+            try:
+                self._store.put(rid_oid, StoredError(err, entry.get("desc", "")))
+                self._raylet.call("notify_object", rid)
+            except Exception:
+                pass
+
+    def _submit_entry(self, entry: dict) -> None:
+        if entry.get("pg_id"):
+            target = self._gcs.call("pick_bundle", entry["pg_id"], entry["bundle_index"])
+            if target is None:
+                raise RuntimeError(
+                    f"placement group {entry['pg_id'][:8]} bundle "
+                    f"{entry['bundle_index']} is not schedulable"
+                )
+            entry = dict(entry)
+            entry["bundle_index"] = target["bundle_index"]
+            self._raylet_for(target["sock"]).call("submit_task", pickle.dumps(entry))
+        else:
+            self._raylet.call("submit_task", pickle.dumps(entry))
 
     def object_future(self, object_id: ObjectID) -> concurrent.futures.Future:
         fut: concurrent.futures.Future = concurrent.futures.Future()
@@ -202,26 +458,22 @@ class ClusterRuntime(Runtime):
     def submit_task(self, spec: TaskSpec) -> List[ObjectID]:
         entry = _entry_from_spec(spec)
         spec.return_ids = [ObjectID.from_hex(h) for h in entry["return_ids"]]
-        if entry.get("pg_id"):
-            # Bundle-pinned: route straight to the node holding the reserved
-            # bundle (reference: bundle scheduling bypasses the hybrid
-            # policy, scheduling_policy.h NodeAffinity-like pinning).
-            target = self._gcs.call("pick_bundle", entry["pg_id"], entry["bundle_index"])
-            if target is None:
-                raise RuntimeError(
-                    f"placement group {entry['pg_id'][:8]} bundle "
-                    f"{entry['bundle_index']} is not schedulable"
-                )
-            entry["bundle_index"] = target["bundle_index"]
-            self._raylet_for(target["sock"]).call("submit_task", pickle.dumps(entry))
-            return spec.return_ids
-        self._raylet.call("submit_task", pickle.dumps(entry))
+        self._record_submission(entry, "task")
+        # Bundle-pinned tasks route straight to the node holding the reserved
+        # bundle (reference: bundle scheduling bypasses the hybrid policy,
+        # scheduling_policy.h NodeAffinity-like pinning).
+        self._submit_entry(entry)
         return spec.return_ids
 
     def create_actor(self, spec: TaskSpec) -> ActorID:
         actor_id = spec.actor_id or ActorID.from_random()
         spec.actor_id = actor_id
         entry = _entry_from_spec(spec)
+        # Pin constructor args for the actor's lifetime: restarts re-run the
+        # constructor from the registered spec, which must resolve them.
+        with self._ref_lock:
+            for dep in entry.get("deps", []):
+                self._local_refs[dep] = self._local_refs.get(dep, 0) + 1
         entry["actor_id"] = actor_id.hex()
         blob = pickle.dumps(entry)
         node = self._gcs.call(
@@ -265,6 +517,7 @@ class ClusterRuntime(Runtime):
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectID]:
         entry = _entry_from_spec(spec)
         spec.return_ids = [ObjectID.from_hex(h) for h in entry["return_ids"]]
+        self._record_submission(entry, "actor_task")
         try:
             self._actor_raylet(spec.actor_id).call("submit_actor_task", pickle.dumps(entry))
         except exc.ActorDiedError:
@@ -328,6 +581,7 @@ class ClusterRuntime(Runtime):
         if self._shutdown_done:
             return
         self._shutdown_done = True
+        self._free_wake.set()
         if self._driver and self._procs:
             for node in self.nodes():
                 try:

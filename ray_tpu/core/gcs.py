@@ -16,6 +16,7 @@ the resource view used for spillback decisions.
 
 from __future__ import annotations
 
+import collections
 import sys
 import threading
 import time
@@ -24,6 +25,9 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 from ..utils.config import CONFIG
 
 HEARTBEAT_TIMEOUT_S = CONFIG.heartbeat_timeout_s
+
+# Finished/failed task records kept for the state API before FIFO eviction.
+TASK_TABLE_CAP = 50_000
 
 
 class GcsService:
@@ -35,6 +39,19 @@ class GcsService:
         self._objects: Dict[str, Set[str]] = {}
         self._kv: Dict[str, bytes] = {}
         self._pgs: Dict[str, dict] = {}
+        # Task table fed by batched raylet events (reference:
+        # gcs_task_manager.h task events; used for owner-side failure
+        # detection, lineage reconstruction decisions, and the state API).
+        self._tasks: "collections.OrderedDict[str, dict]" = collections.OrderedDict()
+        # Cross-process borrow counts + free tombstones (the centralized
+        # stand-in for the reference's owner<->borrower protocol,
+        # reference_count.h WaitForRefRemoved): an owner's free is deferred
+        # while borrowers hold the ref, and a freed object that seals late
+        # (free raced the task) is deleted on arrival.
+        self._borrows: Dict[str, int] = {}
+        self._deferred_free: Set[str] = set()
+        self._free_queue: List[Tuple[float, List[str]]] = []
+        self._freed: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
         self._raylet_clients: Dict[str, Any] = {}
         self._stop = threading.Event()
         self._health = threading.Thread(target=self._health_loop, daemon=True)
@@ -126,7 +143,8 @@ class GcsService:
         return best
 
     def _health_loop(self):
-        while not self._stop.wait(1.0):
+        while not self._stop.wait(0.25):
+            self._process_frees()
             dead = []
             with self._lock:
                 for nid, n in self._nodes.items():
@@ -151,6 +169,14 @@ class GcsService:
                         pass
             for locs in self._objects.values():
                 locs.discard(node_id)
+            # Tasks queued/running on the dead node can never complete there:
+            # mark them failed so owners retry or reconstruct (reference:
+            # task_manager node-death failure propagation).
+            for rec in self._tasks.values():
+                if rec.get("node") == node_id and rec["state"] in ("QUEUED", "RUNNING"):
+                    rec["state"] = "FAILED"
+                    rec["reason"] = "node_died"
+                    rec["ts"] = time.time()
             for aid, a in self._actors.items():
                 if a.get("node_id") == node_id and a["state"] in ("ALIVE", "PENDING"):
                     a["state"] = "RESTARTING" if self._can_restart(a) else "DEAD"
@@ -290,6 +316,15 @@ class GcsService:
             self._objects.setdefault(oid_hex, set()).add(node_id)
         return True
 
+    def remove_object_location(self, oid_hex: str, node_id: str) -> bool:
+        with self._lock:
+            locs = self._objects.get(oid_hex)
+            if locs is not None:
+                locs.discard(node_id)
+                if not locs:
+                    del self._objects[oid_hex]
+        return True
+
     def get_object_locations(self, oid_hex: str) -> List[dict]:
         with self._lock:
             locs = self._objects.get(oid_hex, set())
@@ -298,6 +333,139 @@ class GcsService:
                 for nid in locs
                 if nid in self._nodes and self._nodes[nid]["alive"]
             ]
+
+    def get_object_locations_batch(self, oid_hexes: List[str]) -> Dict[str, List[dict]]:
+        """One round trip for a raylet's whole wait set."""
+        out: Dict[str, List[dict]] = {}
+        with self._lock:
+            for h in oid_hexes:
+                locs = self._objects.get(h)
+                if locs:
+                    out[h] = [
+                        {"node_id": nid, "sock": self._nodes[nid]["sock"]}
+                        for nid in locs
+                        if nid in self._nodes and self._nodes[nid]["alive"]
+                    ]
+        return out
+
+    def free_objects(self, oid_hexes: List[str]) -> bool:
+        """The owner dropped its last reference. The free is executed after
+        a short grace period (by the health loop) so in-flight borrow
+        registrations land first, and is deferred further while any borrower
+        still holds the ref (reference: reference_count.h:64 owner release +
+        WaitForRefRemoved borrower protocol)."""
+        with self._lock:
+            self._free_queue.append((time.monotonic(), list(oid_hexes)))
+        return True
+
+    def _process_frees(self) -> None:
+        grace = 0.25
+        by_node: Dict[str, List[str]] = {}
+        now = time.monotonic()
+        with self._lock:
+            ready = [b for ts, b in self._free_queue if now - ts >= grace]
+            self._free_queue = [e for e in self._free_queue if now - e[0] < grace]
+            for batch in ready:
+                for h in batch:
+                    if self._borrows.get(h, 0) > 0:
+                        self._deferred_free.add(h)
+                    else:
+                        self._release_locked(h, by_node)
+        self._delete_on_nodes(by_node)
+
+    def _release_locked(self, h: str, by_node: Dict[str, List[str]]) -> None:
+        """Tombstones h and collects its copies for deletion (lock held)."""
+        self._freed[h] = True
+        while len(self._freed) > 200_000:
+            self._freed.popitem(last=False)
+        for nid in self._objects.pop(h, ()):  # type: ignore[arg-type]
+            n = self._nodes.get(nid)
+            if n is not None and n["alive"]:
+                by_node.setdefault(n["sock"], []).append(h)
+
+    def _delete_on_nodes(self, by_node: Dict[str, List[str]]) -> None:
+        for sock, hs in by_node.items():
+            try:
+                self._raylet_call(sock, "delete_objects", hs)
+            except Exception:
+                pass  # node going away frees its pool anyway
+
+    def update_borrows(self, deltas: Dict[str, int]) -> bool:
+        """Batched borrow-count adjustments from non-owner processes."""
+        by_node: Dict[str, List[str]] = {}
+        with self._lock:
+            for h, d in deltas.items():
+                c = self._borrows.get(h, 0) + d
+                if c > 0:
+                    self._borrows[h] = c
+                    continue
+                self._borrows.pop(h, None)
+                if h in self._deferred_free:
+                    self._deferred_free.discard(h)
+                    self._release_locked(h, by_node)
+        self._delete_on_nodes(by_node)
+        return True
+
+    # -------------------------------------------------------------- tasks
+    def node_sync(self, node_id: str, sealed: List[str], events: List[dict]) -> bool:
+        """Batched raylet -> GCS sync: object locations + task state events
+        (reference: task_event_buffer.h batching + object directory adds)."""
+        stale: List[str] = []
+        node_sock = None
+        with self._lock:
+            for h in sealed:
+                if h in self._freed:
+                    # The owner freed this object before it sealed (fire-and-
+                    # forget task): delete the late copy instead of indexing it.
+                    stale.append(h)
+                    continue
+                self._objects.setdefault(h, set()).add(node_id)
+            if stale:
+                n = self._nodes.get(node_id)
+                node_sock = n["sock"] if n and n["alive"] else None
+            for evt in events:
+                tid = evt["task_id"]
+                rec = self._tasks.get(tid)
+                if rec is None:
+                    rec = {"task_id": tid, "state": "QUEUED", "name": "", "ts": 0.0}
+                    self._tasks[tid] = rec
+                    # Evict oldest TERMINAL records only: evicting a live
+                    # task would make its owner misread "unknown" as lost
+                    # and double-execute it.
+                    while len(self._tasks) > TASK_TABLE_CAP:
+                        old_tid, old = self._tasks.popitem(last=False)
+                        if old["state"] not in ("FINISHED", "FAILED"):
+                            self._tasks[old_tid] = old
+                            self._tasks.move_to_end(old_tid, last=False)
+                            break
+                # Batches can interleave across nodes; never let a stale
+                # RUNNING overwrite a terminal state from the same attempt,
+                # but a retry (QUEUED with higher attempt) resets it.
+                if evt["state"] == "QUEUED" or rec["state"] not in ("FINISHED", "FAILED"):
+                    rec["state"] = evt["state"]
+                    rec["node"] = node_id
+                    rec["ts"] = evt.get("ts", time.time())
+                    if evt.get("name"):
+                        rec["name"] = evt["name"]
+                    if evt.get("reason"):
+                        rec["reason"] = evt["reason"]
+                    if evt.get("retry"):
+                        rec["retries"] = evt["retry"]
+        if stale and node_sock:
+            try:
+                self._raylet_call(node_sock, "delete_objects", stale)
+            except Exception:
+                pass
+        return True
+
+    def get_task_states(self, task_ids: List[str]) -> Dict[str, dict]:
+        with self._lock:
+            return {tid: dict(self._tasks[tid]) for tid in task_ids if tid in self._tasks}
+
+    def list_tasks(self, limit: int = 1000) -> List[dict]:
+        with self._lock:
+            out = [dict(rec) for rec in self._tasks.values()]
+        return out[-limit:]
 
     # --------------------------------------------------------------- kv
     def kv_put(self, key: str, value: bytes) -> bool:

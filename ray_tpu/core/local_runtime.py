@@ -66,7 +66,31 @@ class LocalRuntime(Runtime):
         self._total_resources = dict(
             resources if resources is not None else detect_node_resources(num_cpus=num_cpus)
         )
+        self._local_refs: Dict[ObjectID, int] = {}
+        self._freed: set = set()  # dropped before the producing task stored
         self._shutdown = False
+
+    # ------------------------------------------------------ refcounting
+    def add_local_ref(self, object_id: ObjectID) -> None:
+        with self._obj_lock:
+            self._local_refs[object_id] = self._local_refs.get(object_id, 0) + 1
+            self._freed.discard(object_id)
+
+    def remove_local_ref(self, object_id: ObjectID) -> None:
+        """Frees the stored value when the last ObjectRef drops (the local
+        analogue of owner-side reference counting, reference:
+        reference_count.h:64)."""
+        with self._obj_lock:
+            c = self._local_refs.get(object_id, 0) - 1
+            if c > 0:
+                self._local_refs[object_id] = c
+                return
+            self._local_refs.pop(object_id, None)
+            if self._objects.pop(object_id, None) is None:
+                # Not stored yet (fire-and-forget): mark so the producing
+                # task's _store skips the value instead of leaking it.
+                self._freed.add(object_id)
+            self._futures.pop(object_id, None)
 
     # ------------------------------------------------------------- objects
     def _future_for(self, oid: ObjectID) -> concurrent.futures.Future:
@@ -81,6 +105,9 @@ class LocalRuntime(Runtime):
 
     def _store(self, oid: ObjectID, status: int, value: Any) -> None:
         with self._obj_lock:
+            if oid in self._freed:
+                self._freed.discard(oid)  # all refs dropped pre-completion
+                return
             self._objects[oid] = (status, value)
             fut = self._futures.get(oid)
             if fut is None:
@@ -201,9 +228,23 @@ class LocalRuntime(Runtime):
         for d in deps:
             self._future_for(d).add_done_callback(on_dep)
 
+    def _pin_deps(self, spec: TaskSpec) -> List[ObjectID]:
+        """Pins argument objects for the task's flight time so a caller
+        dropping its ObjectRef cannot free an in-flight dependency
+        (reference: reference_count.h submitted-task-count pinning)."""
+        deps = self._collect_deps(spec)
+        for d in deps:
+            self.add_local_ref(d)
+        return deps
+
+    def _unpin_deps(self, deps: List[ObjectID]) -> None:
+        for d in deps:
+            self.remove_local_ref(d)
+
     # ------------------------------------------------------------- tasks
     def submit_task(self, spec: TaskSpec) -> List[ObjectID]:
         spec.return_ids = [spec.task_id.object_id_for_return(i) for i in range(spec.num_returns)]
+        deps = self._pin_deps(spec)
 
         def execute():
             try:
@@ -215,6 +256,8 @@ class LocalRuntime(Runtime):
                 self._store_returns(spec, result)
             except BaseException as e:  # noqa: BLE001
                 self._store_error(spec, e)
+            finally:
+                self._unpin_deps(deps)
 
         self._after_deps(spec, lambda: self._pool.submit(execute))
         return spec.return_ids
@@ -233,6 +276,7 @@ class LocalRuntime(Runtime):
                 self._named_actors[key] = actor_id
             self._actors[actor_id] = state
         spec.return_ids = [spec.task_id.object_id_for_return(0)]
+        deps = self._pin_deps(spec)
 
         def construct():
             try:
@@ -245,6 +289,7 @@ class LocalRuntime(Runtime):
                 state.death_reason = f"constructor failed: {e!r}"
                 self._store_error(spec, e)
             finally:
+                self._unpin_deps(deps)
                 state.ready_future.set_result(None)
 
         self._after_deps(spec, lambda: state.pool.submit(construct))
@@ -263,8 +308,10 @@ class LocalRuntime(Runtime):
 
         with state.pending_lock:
             state.pending.update(spec.return_ids)
+        deps = self._pin_deps(spec)
 
         def finish():
+            self._unpin_deps(deps)
             with state.pending_lock:
                 state.pending.difference_update(spec.return_ids)
 

@@ -82,16 +82,71 @@ class RayletService:
 
         self._remote_raylets: Dict[str, RpcClient] = {}
         self._stop = threading.Event()
+
+        # Event-driven object plane: local seals notify this condition so
+        # wait_objects() long-polls wake immediately instead of the old 5 ms
+        # busy-poll (reference: pubsub WAIT_FOR_OBJECT_EVICTION/locality
+        # channels, src/ray/pubsub/publisher.h — collapsed to a per-node
+        # condition because all waiters of this node's store are local).
+        self._seal_cv = threading.Condition()
+        self._pulling: Set[str] = set()
+        # Batched control-plane updates to the GCS (object locations + task
+        # state events), off the task fast path (reference: task events are
+        # batched in the reference too, src/ray/core_worker/task_event_buffer.h).
+        self._loc_buf: List[str] = []
+        self._evt_buf: List[dict] = []
+        self._buf_lock = threading.Lock()
+        self._buf_wake = threading.Event()
+        # Objects whose delete hit a reader pin; retried by the monitor loop
+        # (guarded by _buf_lock: mutated from RPC handler threads).
+        self._deferred_deletes: Set[str] = set()
+
         self._threads = [
             threading.Thread(target=self._scheduler_loop, daemon=True, name="sched"),
             threading.Thread(target=self._heartbeat_loop, daemon=True, name="hb"),
             threading.Thread(target=self._monitor_loop, daemon=True, name="monitor"),
+            threading.Thread(target=self._flush_loop, daemon=True, name="flush"),
         ]
         self.gcs.call(
             "register_node", node_id, sock_path, store_path, resources
         )
         for t in self._threads:
             t.start()
+
+    # ----------------------------------------------- control-plane batching
+    def _notify_sealed(self, oid_hexes: List[str]) -> None:
+        """A local seal: wake waiters now, tell the GCS directory soon."""
+        with self._seal_cv:
+            self._seal_cv.notify_all()
+        with self._buf_lock:
+            self._loc_buf.extend(oid_hexes)
+        self._buf_wake.set()
+
+    def _task_event(self, task_id: str, state: str, **extra) -> None:
+        evt = {"task_id": task_id, "state": state, "ts": time.time()}
+        evt.update(extra)
+        with self._buf_lock:
+            self._evt_buf.append(evt)
+        self._buf_wake.set()
+
+    def _flush_loop(self) -> None:
+        """Drains location + task-event buffers to the GCS (batched; the
+        object fast path never blocks on a GCS round trip)."""
+        while not self._stop.is_set():
+            self._buf_wake.wait(timeout=0.2)
+            self._buf_wake.clear()
+            with self._buf_lock:
+                locs, self._loc_buf = self._loc_buf, []
+                evts, self._evt_buf = self._evt_buf, []
+            if not locs and not evts:
+                continue
+            try:
+                self.gcs.call("node_sync", self.node_id, locs, evts)
+            except Exception:
+                with self._buf_lock:  # GCS briefly unreachable: retry later
+                    self._loc_buf = locs + self._loc_buf
+                    self._evt_buf = evts + self._evt_buf
+                time.sleep(0.5)
 
     # ------------------------------------------------------------ helpers
     def _remote(self, sock: str) -> RpcClient:
@@ -220,6 +275,7 @@ class RayletService:
         if entry.get("pg_id"):
             # Bundle-pinned: the driver routed it to this node; never spill.
             entry["type"] = "task"
+            self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
             self._pending.put(entry)
             return entry["return_ids"]
         if not forwarded:
@@ -243,6 +299,7 @@ class RayletService:
                 if target is not None:
                     return self._remote(target["sock"]).call("submit_task", spec_blob, True)
         entry["type"] = "task"
+        self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
         self._pending.put(entry)
         return entry["return_ids"]
 
@@ -269,6 +326,7 @@ class RayletService:
                 "resources": entry["resources"],
                 "resources_held": False,
             }
+        self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
         self._pending.put(entry)
         return True
 
@@ -286,6 +344,7 @@ class RayletService:
                     ),
                 )
                 return entry["return_ids"]
+        self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
         self._pending.put(entry)
         return entry["return_ids"]
 
@@ -322,12 +381,81 @@ class RayletService:
                     continue
                 if raw is not None:
                     self.store.put_raw(oid, raw)
-                    self.gcs.call("add_object_location", oid_hex, self.node_id)
+                    self._notify_sealed([oid_hex])
                     return True
             if self.store.contains(oid):
                 return True
             time.sleep(0.01)
         return False
+
+    def _pull_async(self, oid_hex: str) -> None:
+        """One in-flight pull per object, shared by all waiters."""
+        with self._seal_cv:
+            if oid_hex in self._pulling:
+                return
+            self._pulling.add(oid_hex)
+
+        def run():
+            try:
+                self.pull_object(oid_hex, timeout=CONFIG.object_wait_poll_s)
+            finally:
+                with self._seal_cv:
+                    self._pulling.discard(oid_hex)
+                    self._seal_cv.notify_all()
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def wait_objects(
+        self,
+        oid_hexes: List[str],
+        num_returns: Optional[int] = None,
+        timeout: float = 10.0,
+        pull: bool = False,
+    ) -> List[str]:
+        """Long-poll until >= num_returns of the objects are available.
+
+        `pull=True` (the get() path) counts only locally-present objects and
+        fetches remote ones in; `pull=False` (the wait() path) counts an
+        object that exists anywhere in the cluster. Wakes on local seal
+        notifications — the event-driven replacement for the driver's old
+        5 ms polling loops (reference: core_worker Wait/Get long-poll on the
+        plasma store + object directory subscriptions)."""
+        if num_returns is None:
+            num_returns = len(oid_hexes)
+        deadline = time.monotonic() + max(0.0, timeout)
+        exists_remote: Set[str] = set()
+        last_loc_check = 0.0
+        while True:
+            ready = [
+                h
+                for h in oid_hexes
+                if self.store.contains(ObjectID.from_hex(h)) or (h in exists_remote)
+            ]
+            if len(ready) >= num_returns:
+                return ready
+            now = time.monotonic()
+            if now >= deadline:
+                return ready
+            missing = [
+                h
+                for h in oid_hexes
+                if h not in exists_remote
+                and not self.store.contains(ObjectID.from_hex(h))
+            ]
+            if missing and now - last_loc_check >= 0.05:
+                last_loc_check = now
+                try:
+                    locs = self.gcs.call("get_object_locations_batch", missing)
+                except Exception:
+                    locs = {}
+                for h, ls in locs.items():
+                    if any(loc["node_id"] != self.node_id for loc in ls):
+                        if pull:
+                            self._pull_async(h)
+                        else:
+                            exists_remote.add(h)
+            with self._seal_cv:
+                self._seal_cv.wait(timeout=min(0.05, max(0.001, deadline - now)))
 
     def fetch_object(self, oid_hex: str) -> Optional[bytes]:
         """Serves the framed payload to a pulling raylet (the push half of
@@ -335,8 +463,22 @@ class RayletService:
         return self.store.get_raw(ObjectID.from_hex(oid_hex))
 
     def notify_object(self, oid_hex: str) -> bool:
-        self.gcs.call("add_object_location", oid_hex, self.node_id)
+        self._notify_sealed([oid_hex])
         return True
+
+    def delete_objects(self, oid_hexes: List[str]) -> int:
+        """Frees objects from the local pool (the owner dropped its last
+        reference; reference: plasma Delete + local_object_manager). Pinned
+        objects (zero-copy readers in flight) are retried by the monitor."""
+        freed = 0
+        for h in oid_hexes:
+            oid = ObjectID.from_hex(h)
+            if self.store.delete(oid):
+                freed += 1
+            elif self.store.contains(oid):
+                with self._buf_lock:
+                    self._deferred_deletes.add(h)
+        return freed
 
     # ----------------------------------------------------- worker service
     def worker_poll(self, worker_id: str) -> dict:
@@ -352,7 +494,12 @@ class RayletService:
         except queue.Empty:
             return {"type": "noop"}
 
-    def worker_done(self, worker_id: str, ok: bool) -> bool:
+    def worker_done(self, worker_id: str, ok: bool, sealed: Optional[List[str]] = None) -> bool:
+        if sealed:
+            # The task's return objects: wake local waiters + batch the
+            # directory update (folded into this RPC so completion costs one
+            # round trip, not one per return object).
+            self._notify_sealed(sealed)
         with self._workers_lock:
             w = self._workers.get(worker_id)
             if w is None:
@@ -367,8 +514,12 @@ class RayletService:
             with self._actor_lock:
                 a = self._actors.get(w.actor_id)
                 if a and a["inflight"]:
-                    a["inflight"].pop(0)
+                    done = a["inflight"].pop(0)
+                    self._task_event(
+                        done["task_id"], "FINISHED" if ok else "FAILED"
+                    )
         if entry is not None:
+            self._task_event(entry["task_id"], "FINISHED" if ok else "FAILED")
             if entry["type"] == "task":
                 self._release_entry(entry)
             elif entry["type"] == "actor_creation":
@@ -430,6 +581,7 @@ class RayletService:
                 self._release_entry(entry)
                 return False
             w.busy_with = entry
+            self._task_event(entry["task_id"], "RUNNING")
             w.mailbox.put({"type": "task", "entry": entry})
             return True
         if kind == "actor_creation":
@@ -471,6 +623,7 @@ class RayletService:
             # serially (reference: actor_scheduling_queue.h ordered queue).
             with self._actor_lock:
                 a["inflight"].append(entry)
+            self._task_event(entry["task_id"], "RUNNING")
             w.mailbox.put({"type": "task", "entry": entry})
             return True
         return True
@@ -517,13 +670,16 @@ class RayletService:
 
     # ---------------------------------------------------------- failures
     def _store_error_for(self, entry: dict, error: BaseException) -> None:
+        sealed = []
         for rid_hex in entry["return_ids"]:
             oid = ObjectID.from_hex(rid_hex.decode() if isinstance(rid_hex, bytes) else rid_hex)
             try:
                 self.store.put(oid, StoredError(error, entry.get("desc", "")))
-                self.gcs.call("add_object_location", oid.hex(), self.node_id)
+                sealed.append(oid.hex())
             except Exception:
                 pass
+        self._notify_sealed(sealed)
+        self._task_event(entry["task_id"], "FAILED", reason=str(error))
 
     def _monitor_loop(self) -> None:
         """Detects worker-process death; fails in-flight work and drives the
@@ -541,13 +697,36 @@ class RayletService:
             for w in dead:
                 entry = w.busy_with
                 if entry is not None:
-                    self._store_error_for(
-                        entry, RuntimeError(f"worker died executing {entry.get('desc','task')}")
-                    )
                     if entry["type"] == "task":
                         self._release_entry(entry)
+                    mr = entry.get("max_retries", 0)
+                    if entry["type"] == "task" and (
+                        mr < 0 or mr - entry.get("attempt", 0) > 0
+                    ):
+                        # Raylet-side retry on worker death (reference:
+                        # task_manager.h:250-256 RetryTask — the owner's
+                        # TaskManager there; here the raylet re-queues since
+                        # the deps are still local).
+                        entry["attempt"] = entry.get("attempt", 0) + 1
+                        self._task_event(
+                            entry["task_id"], "QUEUED", retry=entry["attempt"]
+                        )
+                        self._pending.put(entry)
+                    else:
+                        from .. import exceptions as exc
+
+                        self._store_error_for(
+                            entry,
+                            exc.WorkerCrashedError(
+                                f"worker died executing {entry.get('desc','task')}"
+                            ),
+                        )
                 if w.actor_id is not None:
                     self._on_actor_worker_death(w)
+            with self._buf_lock:
+                retry, self._deferred_deletes = list(self._deferred_deletes), set()
+            if retry:
+                self.delete_objects(retry)
 
     def _on_actor_worker_death(self, w: _Worker) -> None:
         aid = w.actor_id

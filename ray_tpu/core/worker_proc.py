@@ -61,7 +61,7 @@ def main(argv: List[str]) -> None:
 
     actor_instance: Dict[str, Any] = {}  # actor_id -> instance
 
-    def store_returns(entry: dict, result: Any) -> None:
+    def store_returns(entry: dict, result: Any, sealed: List[str]) -> None:
         rids = [ObjectID.from_hex(h) for h in entry["return_ids"]]
         if len(rids) == 1:
             values = [result]
@@ -73,20 +73,20 @@ def main(argv: List[str]) -> None:
                 )
         for rid, v in zip(rids, values):
             store.put(rid, v)
-            raylet.call("notify_object", rid.hex())
+            sealed.append(rid.hex())
 
-    def store_error(entry: dict, err: BaseException) -> None:
+    def store_error(entry: dict, err: BaseException, sealed: List[str]) -> None:
         if not isinstance(err, exc.RayTpuError):
             err = exc.TaskError(err, task_desc=entry.get("desc", ""))
         for h in entry["return_ids"]:
             rid = ObjectID.from_hex(h)
             try:
                 store.put(rid, StoredError(err, entry.get("desc", "")))
-                raylet.call("notify_object", rid.hex())
+                sealed.append(rid.hex())
             except Exception:
                 pass
 
-    def execute(entry: dict) -> bool:
+    def execute(entry: dict, sealed: List[str]) -> bool:
         kind = entry["type"]
         try:
             if kind == "task":
@@ -99,13 +99,13 @@ def main(argv: List[str]) -> None:
                     import asyncio
 
                     result = asyncio.run(result)
-                store_returns(entry, result)
+                store_returns(entry, result, sealed)
                 return True
             if kind == "actor_creation":
                 cls = GLOBAL_FUNCTION_TABLE.loads(entry["func_blob"], entry["func_hash"])
                 args, kwargs = _resolve_args(store, entry["args_blob"])
                 actor_instance[entry["actor_id"]] = cls(*args, **kwargs)
-                store_returns(entry, None)
+                store_returns(entry, None, sealed)
                 return True
             if kind == "actor_task":
                 inst = actor_instance.get(entry["actor_id"])
@@ -120,14 +120,14 @@ def main(argv: List[str]) -> None:
                     import asyncio
 
                     result = asyncio.run(result)
-                store_returns(entry, result)
+                store_returns(entry, result, sealed)
                 return True
             return True
         except SystemExit:
-            store_returns(entry, None)
+            store_returns(entry, None, sealed)
             raise
         except BaseException as e:  # noqa: BLE001
-            store_error(entry, e)
+            store_error(entry, e, sealed)
             return False
 
     while True:
@@ -142,12 +142,13 @@ def main(argv: List[str]) -> None:
             continue
         if kind == "task":
             entry = msg["entry"]
+            sealed: List[str] = []
             try:
-                ok = execute(entry)
+                ok = execute(entry, sealed)
             except SystemExit:
-                raylet.call("worker_done", worker_id, True)
+                raylet.call("worker_done", worker_id, True, sealed)
                 return
-            raylet.call("worker_done", worker_id, ok)
+            raylet.call("worker_done", worker_id, ok, sealed)
 
 
 if __name__ == "__main__":

@@ -69,8 +69,9 @@ class RayTpuConfig:
     # How long a raylet retries cluster placement before failing a task
     # no node can currently satisfy.
     placement_retry_timeout_s: float = _declare("placement_retry_timeout_s", 10.0)
-    # Long-poll duration for object-location waits (pubsub stand-in).
-    object_wait_poll_s: float = _declare("object_wait_poll_s", 10.0)
+    # Long-poll window for object waits; between windows the owner runs its
+    # failure-recovery check, so this bounds retry/reconstruction latency.
+    object_wait_poll_s: float = _declare("object_wait_poll_s", 2.0)
 
     # --- GCS ---------------------------------------------------------------
     # Periodic snapshot interval for GCS table persistence (0 = every write).
