@@ -300,17 +300,21 @@ class Dataset:
                     issued.append(out)
                     yield out
             finally:
-                # Poll until every issued ref has resolved — no hard cap: a
-                # slow tail UDF must not get its worker killed while refs
-                # already yielded downstream are still computing. Progress
-                # is guaranteed (each wait round either resolves refs or the
-                # actor died, which also resolves them with an error).
+                # Poll until every issued ref has resolved. No overall cap —
+                # a slow tail UDF must not get its worker killed while refs
+                # already yielded downstream are still computing — but a
+                # LIVELOCKED UDF (no ref resolving for a sustained window)
+                # must not hang the consumer forever, so zero progress for
+                # 60s escapes to the kill below.
                 pending = list(issued)
-                while pending:
+                stalled = 0.0
+                while pending and stalled < 60.0:
                     try:
+                        before = len(pending)
                         _, pending = api.wait(
                             pending, num_returns=len(pending), timeout=5
                         )
+                        stalled = 0.0 if len(pending) < before else stalled + 5.0
                     except Exception:
                         break
                 for a in actors:

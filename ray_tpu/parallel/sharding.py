@@ -142,6 +142,15 @@ def shard_batch(batch: PyTree, mesh: Mesh, *, seq: bool = False) -> PyTree:
         if multiprocess:
             import numpy as np
 
+            if not spec or spec[0] is None:
+                # The clamp fell back to replication on the batch dim, but
+                # each host holds a DIFFERENT shard — assembling those as
+                # "replicated" silently diverges SPMD state. Fail loudly.
+                raise ValueError(
+                    f"global batch dim {shape[0]} is not divisible by the "
+                    f"batch mesh axes on a {n_proc}-process mesh; pad the "
+                    "batch or adjust data/fsdp axis sizes"
+                )
             return jax.make_array_from_process_local_data(sharding, np.asarray(leaf))
         return jax.device_put(leaf, sharding)
 

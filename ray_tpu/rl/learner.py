@@ -103,7 +103,14 @@ class JaxLearner:
     def load_state(self, directory: str) -> None:
         from ..train.checkpoint import load_pytree
 
-        self.params = load_pytree(directory)["params"]
+        params = load_pytree(directory)["params"]
+        if self.mesh is not None:
+            # Re-place on the mesh like set_weights: host-local numpy params
+            # would hand the jitted update inputs committed to no mesh.
+            from ..parallel.sharding import replicated
+
+            params = jax.device_put(params, replicated(self.mesh))
+        self.params = params
         self.opt_state = self.tx.init(self.params)
 
 
