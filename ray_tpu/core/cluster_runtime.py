@@ -301,7 +301,7 @@ class ClusterRuntime(Runtime):
     # ------------------------------------------------------------ objects
     def put(self, value: Any) -> ObjectID:
         oid = TaskID.for_task().object_id_for_return(0)
-        self._store.put(oid, value)
+        self._store.put_with_pressure(oid, value, self._raylet)
         with self._ref_lock:
             self._owned.add(oid.hex())
         self._raylet.call("notify_object", oid.hex())

@@ -16,6 +16,7 @@ directory before dispatch)."""
 
 from __future__ import annotations
 
+import collections
 import os
 import pickle
 import queue
@@ -26,6 +27,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from .. import exceptions as exc
 from ..utils.config import CONFIG
 from .ids import ObjectID
 from .object_transport import StoredError
@@ -100,6 +102,19 @@ class RayletService:
         # Objects whose delete hit a reader pin; retried by the monitor loop
         # (guarded by _buf_lock: mutated from RPC handler threads).
         self._deferred_deletes: Set[str] = set()
+        # Spill/eviction state (reference: plasma eviction_policy.h:160 LRU +
+        # raylet/local_object_manager.h:41 spill-to-disk): seal-ordered index
+        # of local objects (True = primary copy, False = pulled replica) and
+        # the on-disk locations of spilled primaries.
+        self._spill_dir = store_path + "_spill"
+        os.makedirs(self._spill_dir, exist_ok=True)
+        self._local_objects: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
+        self._spilled: Dict[str, str] = {}
+        self._spill_lock = threading.Lock()
+        # Serializes whole evict/spill/restore sequences: concurrent
+        # ensure_space RPC threads must not unlink each other's fresh
+        # spill files.
+        self._evict_lock = threading.Lock()
 
         self._threads = [
             threading.Thread(target=self._scheduler_loop, daemon=True, name="sched"),
@@ -114,8 +129,13 @@ class RayletService:
             t.start()
 
     # ----------------------------------------------- control-plane batching
-    def _notify_sealed(self, oid_hexes: List[str]) -> None:
+    def _notify_sealed(self, oid_hexes: List[str], primary: bool = True) -> None:
         """A local seal: wake waiters now, tell the GCS directory soon."""
+        if oid_hexes:
+            with self._spill_lock:
+                for h in oid_hexes:
+                    self._local_objects[h] = primary
+                    self._local_objects.move_to_end(h)
         with self._seal_cv:
             self._seal_cv.notify_all()
         with self._buf_lock:
@@ -369,8 +389,14 @@ class RayletService:
         oid = ObjectID.from_hex(oid_hex)
         if self.store.contains(oid):
             return True
+        if self._restore(oid_hex):
+            return True
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
+            if self._restore(oid_hex):
+                # A transiently full pool can fail the first restore; the
+                # spilled file is still the authoritative local copy.
+                return True
             locations = self.gcs.call("get_object_locations", oid_hex)
             for loc in locations:
                 if loc["node_id"] == self.node_id:
@@ -380,8 +406,12 @@ class RayletService:
                 except Exception:
                     continue
                 if raw is not None:
-                    self.store.put_raw(oid, raw)
-                    self._notify_sealed([oid_hex])
+                    try:
+                        self.store.put_raw(oid, raw)
+                    except exc.ObjectStoreFullError:
+                        self.ensure_space(len(raw))
+                        self.store.put_raw(oid, raw)
+                    self._notify_sealed([oid_hex], primary=False)
                     return True
             if self.store.contains(oid):
                 return True
@@ -442,6 +472,10 @@ class RayletService:
                 if h not in exists_remote
                 and not self.store.contains(ObjectID.from_hex(h))
             ]
+            if pull and missing:
+                for h in missing:
+                    if h in self._spilled:
+                        self._restore(h)
             if missing and now - last_loc_check >= 0.05:
                 last_loc_check = now
                 try:
@@ -459,8 +493,117 @@ class RayletService:
 
     def fetch_object(self, oid_hex: str) -> Optional[bytes]:
         """Serves the framed payload to a pulling raylet (the push half of
-        the reference's object-manager transfer, push_manager.h:30)."""
-        return self.store.get_raw(ObjectID.from_hex(oid_hex))
+        the reference's object-manager transfer, push_manager.h:30); spilled
+        primaries are served straight from disk."""
+        raw = self.store.get_raw(ObjectID.from_hex(oid_hex))
+        if raw is not None:
+            return raw
+        with self._spill_lock:
+            path = self._spilled.get(oid_hex)
+        if path is not None:
+            try:
+                with open(path, "rb") as f:
+                    return f.read()
+            except OSError:
+                return None
+        return None
+
+    # ---------------------------------------------------- eviction / spill
+    def _spill_to(self, target_bytes: int) -> bool:
+        with self._evict_lock:
+            return self._spill_to_locked(target_bytes)
+
+    def _spill_to_locked(self, target_bytes: int) -> bool:
+        """Evicts replicas / spills primaries (seal order ≈ LRU) until pool
+        usage is at or below target (reference: eviction_policy.h:160 +
+        local_object_manager.h:41). Returns True when the target is met."""
+        while self.store.bytes_in_use() > target_bytes:
+            if not self._evict_or_spill_one_locked():
+                return self.store.bytes_in_use() <= target_bytes
+        return True
+
+    def _evict_or_spill_one_locked(self) -> bool:
+        with self._spill_lock:
+            candidates = list(self._local_objects.items())
+        for h, primary in candidates:
+            oid = ObjectID.from_hex(h)
+            if not self.store.contains(oid):
+                with self._spill_lock:
+                    self._local_objects.pop(h, None)
+                continue
+            if not primary:
+                # A pulled replica: another node holds the primary, so a
+                # plain delete is safe once the directory forgets us.
+                if self.store.delete(oid):
+                    with self._spill_lock:
+                        self._local_objects.pop(h, None)
+                    try:
+                        self.gcs.call("remove_object_location", h, self.node_id)
+                    except Exception:
+                        pass
+                    return True
+                continue  # pinned by a reader; try the next candidate
+            raw = self.store.get_raw(oid)
+            if raw is None:
+                with self._spill_lock:
+                    self._local_objects.pop(h, None)
+                continue
+            path = os.path.join(self._spill_dir, h)
+            try:
+                with open(path + ".tmp", "wb") as f:
+                    f.write(raw)
+                os.replace(path + ".tmp", path)
+            except OSError:
+                return False  # disk full/unwritable: stop spilling
+            if self.store.delete(oid):
+                with self._spill_lock:
+                    self._spilled[h] = path
+                    self._local_objects.pop(h, None)
+                return True
+            try:
+                os.unlink(path)  # pinned after all; keep the pool copy
+            except OSError:
+                pass
+        return False
+
+    def ensure_space(self, nbytes: int) -> bool:
+        """Client-side ObjectStoreFullError escape hatch: make room for an
+        allocation of `nbytes` by evicting/spilling."""
+        target = max(0, int(self.store.capacity() * 0.95) - int(nbytes))
+        return self._spill_to(target)
+
+    def _restore(self, oid_hex: str) -> bool:
+        """Brings a spilled object back into the pool (serialized with
+        eviction so a concurrent spill cannot unlink the file mid-read)."""
+        with self._evict_lock:
+            with self._spill_lock:
+                path = self._spilled.get(oid_hex)
+            if path is None:
+                return False
+            try:
+                with open(path, "rb") as f:
+                    raw = f.read()
+            except OSError:
+                return False
+            oid = ObjectID.from_hex(oid_hex)
+            try:
+                self.store.put_raw(oid, raw)
+            except exc.ObjectStoreFullError:
+                self._spill_to_locked(
+                    max(0, int(self.store.capacity() * 0.95) - len(raw))
+                )
+                try:
+                    self.store.put_raw(oid, raw)
+                except exc.ObjectStoreFullError:
+                    return False
+            with self._spill_lock:
+                self._spilled.pop(oid_hex, None)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._notify_sealed([oid_hex])
+        return True
 
     def notify_object(self, oid_hex: str) -> bool:
         self._notify_sealed([oid_hex])
@@ -473,6 +616,14 @@ class RayletService:
         freed = 0
         for h in oid_hexes:
             oid = ObjectID.from_hex(h)
+            with self._spill_lock:
+                self._local_objects.pop(h, None)
+                spill_path = self._spilled.pop(h, None)
+            if spill_path is not None:
+                try:
+                    os.unlink(spill_path)
+                except OSError:
+                    pass
             if self.store.delete(oid):
                 freed += 1
             elif self.store.contains(oid):
@@ -713,8 +864,6 @@ class RayletService:
                         )
                         self._pending.put(entry)
                     else:
-                        from .. import exceptions as exc
-
                         self._store_error_for(
                             entry,
                             exc.WorkerCrashedError(
@@ -727,6 +876,10 @@ class RayletService:
                 retry, self._deferred_deletes = list(self._deferred_deletes), set()
             if retry:
                 self.delete_objects(retry)
+            # Background pressure relief: spill ahead of allocation failures.
+            cap = self.store.capacity()
+            if self.store.bytes_in_use() > CONFIG.spill_threshold * cap:
+                self._spill_to(int(0.75 * CONFIG.spill_threshold * cap))
 
     def _on_actor_worker_death(self, w: _Worker) -> None:
         aid = w.actor_id

@@ -157,7 +157,8 @@ class SharedMemoryStore:
         if rc == -errno.ENOMEM:
             raise exc.ObjectStoreFullError(
                 f"object of {size} bytes does not fit (in use: {self.bytes_in_use()}"
-                f" / {self.capacity()})"
+                f" / {self.capacity()})",
+                nbytes=size,
             )
         if rc != 0:
             raise OSError(-rc, "rtpu_create failed")
@@ -186,7 +187,9 @@ class SharedMemoryStore:
         if rc == -errno.EEXIST:
             return
         if rc == -errno.ENOMEM:
-            raise exc.ObjectStoreFullError(f"object of {len(data)} bytes does not fit")
+            raise exc.ObjectStoreFullError(
+                f"object of {len(data)} bytes does not fit", nbytes=len(data)
+            )
         if rc != 0:
             raise OSError(-rc, "rtpu_create failed")
         self._mv[off.value : off.value + len(data)] = data
@@ -246,6 +249,25 @@ class SharedMemoryStore:
         """Returns True if freed now; False if pinned (caller retries later)."""
         rc = self._lib.rtpu_delete(self._handle, oid.binary())
         return rc == 0
+
+    def put_with_pressure(self, oid: ObjectID, value: Any, raylet, deadline_s: float = 15.0) -> None:
+        """put() with bounded retry under pool pressure: asks the raylet to
+        evict/spill and waits for readers to drop zero-copy pins (reference:
+        plasma's queued CreateRequest retries before ObjectStoreFullError)."""
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                self.put(oid, value)
+                return
+            except exc.ObjectStoreFullError as e:
+                raylet.call("ensure_space", e.nbytes)
+                try:
+                    self.put(oid, value)
+                    return
+                except exc.ObjectStoreFullError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.25)
 
     def bytes_in_use(self) -> int:
         return self._lib.rtpu_bytes_in_use(self._handle)

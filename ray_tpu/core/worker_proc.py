@@ -21,7 +21,7 @@ from .ids import ActorID, ObjectID
 from .task_spec import GLOBAL_FUNCTION_TABLE
 
 
-def _resolve_args(store, args_blob: bytes):
+def _resolve_args(store, args_blob: bytes, raylet=None):
     from .object_transport import StoredError
     from .task_spec import ArgRef
 
@@ -29,7 +29,17 @@ def _resolve_args(store, args_blob: bytes):
 
     def fetch(a):
         if isinstance(a, ArgRef):
-            v = store.get(a.object_id, timeout=30.0)
+            try:
+                # timeout=None raises KeyError immediately on a miss (deps
+                # were sealed before dispatch, so absent == spilled/evicted).
+                v = store.get(a.object_id, timeout=None)
+            except KeyError:
+                # Ask the raylet to restore/re-pull the spilled dep.
+                if raylet is None:
+                    raise
+                if not raylet.call("pull_object", a.object_id.hex(), 30.0):
+                    raise
+                v = store.get(a.object_id, timeout=5.0)
             if isinstance(v, StoredError):
                 raise v.error
             return v
@@ -72,7 +82,7 @@ def main(argv: List[str]) -> None:
                     f"task returned {len(values)} values, expected {len(rids)}"
                 )
         for rid, v in zip(rids, values):
-            store.put(rid, v)
+            store.put_with_pressure(rid, v, raylet)
             sealed.append(rid.hex())
 
     def store_error(entry: dict, err: BaseException, sealed: List[str]) -> None:
@@ -91,7 +101,7 @@ def main(argv: List[str]) -> None:
         try:
             if kind == "task":
                 fn = GLOBAL_FUNCTION_TABLE.loads(entry["func_blob"], entry["func_hash"])
-                args, kwargs = _resolve_args(store, entry["args_blob"])
+                args, kwargs = _resolve_args(store, entry["args_blob"], raylet)
                 result = fn(*args, **kwargs)
                 import inspect
 
@@ -103,7 +113,7 @@ def main(argv: List[str]) -> None:
                 return True
             if kind == "actor_creation":
                 cls = GLOBAL_FUNCTION_TABLE.loads(entry["func_blob"], entry["func_hash"])
-                args, kwargs = _resolve_args(store, entry["args_blob"])
+                args, kwargs = _resolve_args(store, entry["args_blob"], raylet)
                 actor_instance[entry["actor_id"]] = cls(*args, **kwargs)
                 store_returns(entry, None, sealed)
                 return True
@@ -112,7 +122,7 @@ def main(argv: List[str]) -> None:
                 if inst is None:
                     raise RuntimeError("actor instance missing in worker")
                 method = getattr(inst, entry["method_name"])
-                args, kwargs = _resolve_args(store, entry["args_blob"])
+                args, kwargs = _resolve_args(store, entry["args_blob"], raylet)
                 result = method(*args, **kwargs)
                 import inspect
 

@@ -63,7 +63,9 @@ class ObjectLostError(RayTpuError):
 
 
 class ObjectStoreFullError(RayTpuError):
-    pass
+    def __init__(self, msg: str = "", nbytes: int = 0):
+        self.nbytes = nbytes  # allocation size that failed (spill hint)
+        super().__init__(msg)
 
 
 class WorkerCrashedError(RayTpuError):

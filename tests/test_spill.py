@@ -1,0 +1,45 @@
+"""Object-store eviction + spill-to-disk under memory pressure.
+
+Round-3 done-criterion: fill the pool to 3x capacity without error and
+read everything back (reference: plasma eviction_policy.h:160,
+raylet/local_object_manager.h:41 spill/restore)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+
+
+@pytest.fixture
+def small_pool():
+    rt.shutdown()
+    rt.init(num_cpus=2, num_workers=2, object_store_memory=64 << 20)
+    yield rt
+    rt.shutdown()
+
+
+def test_put_3x_capacity_and_read_back(small_pool):
+    n, size = 24, 8 << 20  # 192 MiB through a 64 MiB pool
+    refs = []
+    for i in range(n):
+        refs.append(rt.put(np.full(size, i % 251, dtype=np.uint8)))
+    # Everything is readable, including early objects that were spilled.
+    for i, ref in enumerate(refs):
+        v = rt.get(ref, timeout=60)
+        assert v[0] == i % 251 and v.nbytes == size
+        del v
+
+
+def test_task_outputs_spill(small_pool):
+    @rt.remote
+    def big(i):
+        return np.full(8 << 20, i, dtype=np.uint8)
+
+    refs = [big.remote(i) for i in range(16)]  # 128 MiB of outputs
+    # Consume one at a time: holding all values at once would pin 2x the
+    # pool capacity in zero-copy reader views, which (as in plasma) cannot
+    # be evicted.
+    for i, ref in enumerate(refs):
+        v = rt.get(ref, timeout=120)
+        assert v[0] == i
+        del v
