@@ -1,0 +1,175 @@
+"""Core runtime microbenchmarks, mirroring the reference's ray_perf suite
+(reference: python/ray/_private/ray_perf.py:93; baseline numbers in
+BASELINE.md §"Core microbenchmarks").
+
+Runs against the multi-process cluster runtime on this machine and prints
+ONE JSON line per metric plus a summary line. Usage:
+
+    python bench_core.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import ray_tpu as rt
+
+BASELINE = {
+    "single_client_tasks_sync": 942.3,
+    "single_client_tasks_async": 7997.5,
+    "1_1_actor_calls_sync": 1934.5,
+    "1_1_actor_calls_async": 8761.3,
+    "1_n_actor_calls_async": 8623.7,
+    "single_client_get_calls": 10411.9,
+    "single_client_put_calls": 4961.7,
+    "single_client_put_gigabytes": 17.8,
+    "placement_group_create_removal": 752.4,
+    "single_client_wait_1k_refs": 5.2,
+}
+
+
+def timeit(name: str, fn, multiplier: int = 1, min_time: float = 2.0):
+    """Mirrors ray_perf's timeit: run fn repeatedly for >= min_time, report
+    multiplier * calls / sec."""
+    # warmup
+    fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < min_time:
+        fn()
+        count += 1
+    dt = time.perf_counter() - start
+    rate = multiplier * count / dt
+    base = BASELINE.get(name)
+    print(
+        json.dumps(
+            {
+                "metric": name,
+                "value": round(rate, 1),
+                "unit": "op/s" if name != "single_client_put_gigabytes" else "GB/s",
+                "vs_baseline": round(rate / base, 3) if base else None,
+            }
+        ),
+        flush=True,
+    )
+    return name, rate
+
+
+def main():
+    quick = "--quick" in sys.argv
+    min_time = 0.5 if quick else 2.0
+    results = {}
+
+    # Overcommit CPUs: these measure runtime overhead (RPC, scheduling,
+    # store), not compute, and the bench box may expose a single core. The
+    # pool is sized so the put-GB/s row measures memcpy, not spill churn.
+    rt.init(num_cpus=8, num_workers=2, object_store_memory=2 << 30)
+
+    @rt.remote
+    def small():
+        return b"ok"
+
+    @rt.remote
+    class Counter:
+        def small(self):
+            return b"ok"
+
+    # Warm the worker pool so spawn cost is excluded (as in ray_perf, which
+    # benchmarks against a warm cluster).
+    rt.get([small.remote() for _ in range(32)])
+
+    def bench(name, fn, multiplier=1):
+        results.update([timeit(name, fn, multiplier, min_time)])
+
+    bench("single_client_tasks_sync", lambda: rt.get(small.remote()))
+
+    def async_tasks():
+        rt.get([small.remote() for _ in range(1000)])
+
+    bench("single_client_tasks_async", async_tasks, multiplier=1000)
+
+    a = Counter.remote()
+    rt.get(a.small.remote())
+    bench("1_1_actor_calls_sync", lambda: rt.get(a.small.remote()))
+
+    def actor_async():
+        rt.get([a.small.remote() for _ in range(1000)])
+
+    bench("1_1_actor_calls_async", actor_async, multiplier=1000)
+
+    actors = [Counter.remote() for _ in range(4)]
+    rt.get([b.small.remote() for b in actors])
+
+    def one_n_async():
+        rt.get([b.small.remote() for b in actors for _ in range(250)])
+
+    bench("1_n_actor_calls_async", one_n_async, multiplier=1000)
+
+    obj = rt.put(b"x" * 1024)
+    bench("single_client_get_calls", lambda: [rt.get(obj) for _ in range(100)], multiplier=100)
+
+    def puts():
+        refs = [rt.put(b"x" * 1024) for _ in range(100)]
+        del refs
+
+    bench("single_client_put_calls", puts, multiplier=100)
+
+    big = np.zeros(256 << 20 if not quick else 32 << 20, dtype=np.uint8)
+    gb = big.nbytes / (1 << 30)
+
+    def put_gb():
+        r = rt.put(big)
+        del r
+
+    # Cycle the pool once first so the steady state is measured against
+    # warm pages (as with a long-lived cluster), not first-touch faults.
+    for _ in range((2 << 30) // big.nbytes + 2):
+        put_gb()
+        time.sleep(0.01)
+    bench("single_client_put_gigabytes", put_gb, multiplier=gb)
+
+    refs_1k = [rt.put(b"y") for _ in range(1000)]
+    bench(
+        "single_client_wait_1k_refs",
+        lambda: rt.wait(refs_1k, num_returns=1000, timeout=10),
+    )
+    del refs_1k
+
+    from ray_tpu.core.placement_group import placement_group, remove_placement_group
+
+    def pg_cycle():
+        pgs = [placement_group([{"CPU": 0.01}]) for _ in range(10)]
+        for pg in pgs:
+            remove_placement_group(pg)
+
+    bench("placement_group_create_removal", pg_cycle, multiplier=10)
+
+    rt.shutdown()
+    summary = {
+        "metric": "core_microbench_geomean_vs_baseline",
+        "value": round(
+            float(
+                np.exp(
+                    np.mean(
+                        [
+                            np.log(results[k] / BASELINE[k])
+                            for k in results
+                            if k in BASELINE
+                        ]
+                    )
+                )
+            ),
+            3,
+        ),
+        "unit": "x",
+        "vs_baseline": None,
+    }
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

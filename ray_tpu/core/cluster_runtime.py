@@ -287,6 +287,30 @@ class ClusterRuntime(Runtime):
                         self._pending_free = batch + self._pending_free
                     time.sleep(0.2)
 
+    def flush_local_frees(self) -> None:
+        """Synchronously pushes this owner's pending free batch to the GCS
+        (called under pool pressure so dead objects free up space before
+        anything live is spilled). Borrow deltas go first — a free landing
+        before this process's own borrow registration would be executed
+        against an undercounted object."""
+        with self._ref_lock:
+            batch, self._pending_free = self._pending_free, []
+            borrows, self._borrow_buf = self._borrow_buf, {}
+        borrows = {h: d for h, d in borrows.items() if d != 0}
+        if borrows:
+            try:
+                self._gcs.call("update_borrows", borrows)
+            except Exception:
+                with self._ref_lock:
+                    for h, d in borrows.items():
+                        self._borrow_buf[h] = self._borrow_buf.get(h, 0) + d
+        if batch:
+            try:
+                self._gcs.call("free_objects", batch)
+            except Exception:
+                with self._ref_lock:
+                    self._pending_free = batch + self._pending_free
+
     def _record_submission(self, entry: dict, kind: str) -> None:
         rec = _TaskRecord(entry, kind)
         with self._ref_lock:
@@ -301,10 +325,12 @@ class ClusterRuntime(Runtime):
     # ------------------------------------------------------------ objects
     def put(self, value: Any) -> ObjectID:
         oid = TaskID.for_task().object_id_for_return(0)
-        self._store.put_with_pressure(oid, value, self._raylet)
+        self._store.put_with_pressure(
+            oid, value, self._raylet, pre_pressure=self.flush_local_frees
+        )
         with self._ref_lock:
             self._owned.add(oid.hex())
-        self._raylet.call("notify_object", oid.hex())
+        self._raylet.notify("notify_object", oid.hex())
         return oid
 
     def _get_one(self, oid: ObjectID, deadline: Optional[float]) -> Any:
@@ -423,8 +449,14 @@ class ClusterRuntime(Runtime):
         for rid in entry["return_ids"]:
             rid_oid = ObjectID.from_hex(rid)
             try:
-                self._store.put(rid_oid, StoredError(err, entry.get("desc", "")))
-                self._raylet.call("notify_object", rid)
+                self._store.put_with_pressure(
+                    rid_oid,
+                    StoredError(err, entry.get("desc", "")),
+                    self._raylet,
+                    deadline_s=5.0,
+                    pre_pressure=self.flush_local_frees,
+                )
+                self._raylet.notify("notify_object", rid)
             except Exception:
                 pass
 
@@ -438,9 +470,12 @@ class ClusterRuntime(Runtime):
                 )
             entry = dict(entry)
             entry["bundle_index"] = target["bundle_index"]
-            self._raylet_for(target["sock"]).call("submit_task", pickle.dumps(entry))
+            self._raylet_for(target["sock"]).notify("submit_task", pickle.dumps(entry))
         else:
-            self._raylet.call("submit_task", pickle.dumps(entry))
+            # One-way submit: return ids are owner-computed, infeasibility
+            # surfaces as a stored error object, and lost submits are caught
+            # by the task-table recovery path — no ack roundtrip needed.
+            self._raylet.notify("submit_task", pickle.dumps(entry))
 
     def object_future(self, object_id: ObjectID) -> concurrent.futures.Future:
         fut: concurrent.futures.Future = concurrent.futures.Future()
@@ -627,6 +662,8 @@ class Cluster:
         self.gcs_sock = os.path.join(self.session_dir, "gcs.sock")
         self._procs: List[subprocess.Popen] = []
         self._node_procs: Dict[str, subprocess.Popen] = {}
+        self._store_paths: Dict[str, str] = {}
+        self._shm_claimed = 0
         self._store_capacity = int(object_store_memory or CONFIG.object_store_memory)
 
         gcs_proc = subprocess.Popen(
@@ -656,7 +693,22 @@ class Cluster:
         return os.path.join(self.session_dir, f"raylet_{node_id}.sock")
 
     def _store_for(self, node_id: str) -> str:
-        return os.path.join(self.session_dir, f"store_{node_id}")
+        # The pool lives on tmpfs when available (like plasma's /dev/shm
+        # default): a disk-backed mmap caps put() at disk writeback speed.
+        path = self._store_paths.get(node_id)
+        if path is None:
+            path = os.path.join(self.session_dir, f"store_{node_id}")
+            if os.path.isdir("/dev/shm"):
+                st = os.statvfs("/dev/shm")
+                # Pool files are sparse, so statvfs alone would let every
+                # node pass the same check; count capacity already claimed
+                # by this cluster's earlier stores (overcommit -> SIGBUS).
+                free = st.f_bavail * st.f_frsize - self._shm_claimed
+                if free > self._store_capacity * 1.1:
+                    path = f"/dev/shm/rtpu_{os.path.basename(self.session_dir)}_{node_id}"
+                    self._shm_claimed += self._store_capacity
+            self._store_paths[node_id] = path
+        return path
 
     # ---------------------------------------------------------- add node
     def add_node(
@@ -717,6 +769,12 @@ class Cluster:
         for p in self._procs:
             if p.poll() is None:
                 p.kill()
+        # Unlink tmpfs pool files (nothing reclaims /dev/shm automatically).
+        for node_id in list(self._node_procs) + [self.head_node_id]:
+            try:
+                os.unlink(self._store_for(node_id))
+            except OSError:
+                pass
 
     def shutdown(self):
         self._cleanup()

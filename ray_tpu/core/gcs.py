@@ -58,7 +58,7 @@ class GcsService:
         self._health.start()
 
     # ------------------------------------------------------------- nodes
-    def register_node(self, node_id: str, sock_path: str, store_path: str, resources: dict) -> bool:
+    def register_node(self, node_id: str, sock_path: str, store_path: str, resources: dict) -> dict:
         with self._lock:
             self._nodes[node_id] = {
                 "sock": sock_path,
@@ -68,17 +68,20 @@ class GcsService:
                 "alive": True,
                 "last_hb": time.monotonic(),
             }
-        return True
+            return {"ok": True, "nodes": sum(1 for n in self._nodes.values() if n["alive"])}
 
-    def heartbeat(self, node_id: str, available: dict) -> bool:
+    def heartbeat(self, node_id: str, available: dict) -> dict:
         with self._lock:
             n = self._nodes.get(node_id)
+            alive = sum(1 for m in self._nodes.values() if m["alive"])
             if n is None:
-                return False
+                return {"ok": False, "nodes": alive}
             n["available"] = dict(available)
             n["last_hb"] = time.monotonic()
-            n["alive"] = True
-        return True
+            if not n["alive"]:
+                n["alive"] = True
+                alive += 1
+        return {"ok": True, "nodes": alive}
 
     def drain_node(self, node_id: str) -> bool:
         with self._lock:
@@ -143,7 +146,7 @@ class GcsService:
         return best
 
     def _health_loop(self):
-        while not self._stop.wait(0.25):
+        while not self._stop.wait(0.1):
             self._process_frees()
             dead = []
             with self._lock:
@@ -228,7 +231,14 @@ class GcsService:
                         f"placement group {pg_id[:8]} bundle {bundle_index} not available"
                     )
             else:
-                node = self.pick_node(resources)
+                # The resource view lags a heartbeat behind a task burst:
+                # give it a couple of periods to catch up before refusing.
+                deadline = time.monotonic() + 3 * CONFIG.heartbeat_interval_s
+                while True:
+                    node = self.pick_node(resources)
+                    if node is not None or time.monotonic() > deadline:
+                        break
+                    time.sleep(0.1)
                 if node is None:
                     raise RuntimeError(f"no node can host actor requiring {resources}")
         except BaseException:
@@ -358,8 +368,14 @@ class GcsService:
             self._free_queue.append((time.monotonic(), list(oid_hexes)))
         return True
 
-    def _process_frees(self) -> None:
-        grace = 0.25
+    def flush_frees(self) -> bool:
+        """Prompt free processing for a raylet under pool pressure. A small
+        grace remains: other processes' borrow registrations flush on a
+        ~20 ms cadence and must land before their objects' frees execute."""
+        self._process_frees(grace=0.05)
+        return True
+
+    def _process_frees(self, grace: float = 0.1) -> None:
         by_node: Dict[str, List[str]] = {}
         now = time.monotonic()
         with self._lock:

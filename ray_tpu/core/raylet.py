@@ -78,6 +78,11 @@ class RayletService:
         self._max_task_workers = max(1, int(resources.get("CPU", 1)))
 
         self._pending: "queue.Queue" = queue.Queue()  # task entries
+        # Wakes the dispatch loop on any schedulability change (new task,
+        # worker freed, dep sealed, bundle released) instead of a 50 ms
+        # poll cadence (reference: local_task_manager ScheduleAndDispatch
+        # being invoked from every state-change site).
+        self._sched_wake = threading.Event()
         self._waiting: List[dict] = []  # dep-blocked entries
         self._actors: Dict[str, dict] = {}  # actor_id -> {worker_id, queue, state}
         self._actor_lock = threading.Lock()
@@ -106,7 +111,11 @@ class RayletService:
         # raylet/local_object_manager.h:41 spill-to-disk): seal-ordered index
         # of local objects (True = primary copy, False = pulled replica) and
         # the on-disk locations of spilled primaries.
-        self._spill_dir = store_path + "_spill"
+        # Spill lands next to the raylet socket (session dir, disk-backed):
+        # spilling INTO tmpfs would defeat the point of relieving the pool.
+        self._spill_dir = os.path.join(
+            os.path.dirname(sock_path) or ".", f"spill_{node_id}"
+        )
         os.makedirs(self._spill_dir, exist_ok=True)
         self._local_objects: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
         self._spilled: Dict[str, str] = {}
@@ -122,9 +131,10 @@ class RayletService:
             threading.Thread(target=self._monitor_loop, daemon=True, name="monitor"),
             threading.Thread(target=self._flush_loop, daemon=True, name="flush"),
         ]
-        self.gcs.call(
+        reg = self.gcs.call(
             "register_node", node_id, sock_path, store_path, resources
         )
+        self._cluster_size = reg.get("nodes", 1) if isinstance(reg, dict) else 1
         for t in self._threads:
             t.start()
 
@@ -141,6 +151,7 @@ class RayletService:
         with self._buf_lock:
             self._loc_buf.extend(oid_hexes)
         self._buf_wake.set()
+        self._sched_wake.set()  # a sealed object may unblock queued tasks
 
     def _task_event(self, task_id: str, state: str, **extra) -> None:
         evt = {"task_id": task_id, "state": state, "ts": time.time()}
@@ -215,6 +226,7 @@ class RayletService:
                 return False
             for k, v in b["reserved"].items():
                 self.available[k] = min(self.total.get(k, 0.0), self.available.get(k, 0.0) + v)
+        self._sched_wake.set()
         return True
 
     def _fail_if_unschedulable(self, entry: dict) -> bool:
@@ -297,31 +309,59 @@ class RayletService:
             entry["type"] = "task"
             self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
             self._pending.put(entry)
+            self._sched_wake.set()
             return entry["return_ids"]
         if not forwarded:
             # Cluster-level decision: if it can't run here (ever, or not
             # soon) and another node has room now, forward it.
             if not self._fits_total(resources):
-                # The GCS resource view lags by one heartbeat; a busy-now
-                # node may free up, so retry placement before failing.
-                deadline = time.monotonic() + CONFIG.placement_retry_timeout_s
-                target = None
-                while target is None:
+                # Infeasible here. Hand placement to a background thread:
+                # the GCS view lags by a heartbeat (a capable node may
+                # appear), and the submit RPC is one-way so a failure must
+                # surface as a stored error object, not a raise.
+                threading.Thread(
+                    target=self._place_elsewhere, args=(entry, spec_blob), daemon=True
+                ).start()
+                return entry["return_ids"]
+            if self._cluster_size > 1 and not self._can_run_soon(resources):
+                # On a single-node cluster there is nowhere to spill, so the
+                # GCS round trip is skipped (hot under submission storms).
+                # Submission is one-way, so spillback failures must not
+                # raise: fall back to queuing locally (feasible here).
+                try:
                     target = self.gcs.call("pick_node", resources, [self.node_id])
                     if target is not None:
-                        break
-                    if time.monotonic() > deadline:
-                        raise RuntimeError(f"no node can satisfy {resources}")
-                    time.sleep(0.1)
-                return self._remote(target["sock"]).call("submit_task", spec_blob, True)
-            if not self._can_run_soon(resources):
-                target = self.gcs.call("pick_node", resources, [self.node_id])
-                if target is not None:
-                    return self._remote(target["sock"]).call("submit_task", spec_blob, True)
+                        return self._remote(target["sock"]).call(
+                            "submit_task", spec_blob, True
+                        )
+                except Exception:
+                    pass
         entry["type"] = "task"
         self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
         self._pending.put(entry)
+        self._sched_wake.set()
         return entry["return_ids"]
+
+    def _place_elsewhere(self, entry: dict, spec_blob: bytes) -> None:
+        """Finds a node for a task this node can never run; retries while
+        the GCS view catches up, then fails the task visibly."""
+        resources = entry["resources"]
+        deadline = time.monotonic() + CONFIG.placement_retry_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                target = self.gcs.call("pick_node", resources, [self.node_id])
+            except Exception:
+                target = None
+            if target is not None:
+                try:
+                    self._remote(target["sock"]).call("submit_task", spec_blob, True)
+                    return
+                except Exception:
+                    pass  # target died mid-forward; retry placement
+            time.sleep(0.1)
+        self._store_error_for(
+            entry, RuntimeError(f"no node can satisfy {resources}")
+        )
 
     def _can_run_soon(self, resources) -> bool:
         with self._res_lock:
@@ -348,6 +388,7 @@ class RayletService:
             }
         self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
         self._pending.put(entry)
+        self._sched_wake.set()
         return True
 
     def submit_actor_task(self, spec_blob: bytes) -> List[bytes]:
@@ -366,6 +407,7 @@ class RayletService:
                 return entry["return_ids"]
         self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
         self._pending.put(entry)
+        self._sched_wake.set()
         return entry["return_ids"]
 
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> bool:
@@ -410,7 +452,10 @@ class RayletService:
                         self.store.put_raw(oid, raw)
                     except exc.ObjectStoreFullError:
                         self.ensure_space(len(raw))
-                        self.store.put_raw(oid, raw)
+                        try:
+                            self.store.put_raw(oid, raw)
+                        except exc.ObjectStoreFullError:
+                            break  # pins may drop; retry within the deadline
                     self._notify_sealed([oid_hex], primary=False)
                     return True
             if self.store.contains(oid):
@@ -459,7 +504,9 @@ class RayletService:
             ready = [
                 h
                 for h in oid_hexes
-                if self.store.contains(ObjectID.from_hex(h)) or (h in exists_remote)
+                if self.store.contains(ObjectID.from_hex(h))
+                or (h in exists_remote)
+                or (not pull and h in self._spilled)  # spilled == exists
             ]
             if len(ready) >= num_returns:
                 return ready
@@ -516,60 +563,72 @@ class RayletService:
     def _spill_to_locked(self, target_bytes: int) -> bool:
         """Evicts replicas / spills primaries (seal order ≈ LRU) until pool
         usage is at or below target (reference: eviction_policy.h:160 +
-        local_object_manager.h:41). Returns True when the target is met."""
-        while self.store.bytes_in_use() > target_bytes:
-            if not self._evict_or_spill_one_locked():
-                return self.store.bytes_in_use() <= target_bytes
-        return True
-
-    def _evict_or_spill_one_locked(self) -> bool:
+        local_object_manager.h:41). One snapshot, one forward scan — a
+        rescan per freed object would be O(n*k). Returns True when the
+        target is met."""
+        if self.store.bytes_in_use() <= target_bytes:
+            return True
         with self._spill_lock:
             candidates = list(self._local_objects.items())
         for h, primary in candidates:
-            oid = ObjectID.from_hex(h)
-            if not self.store.contains(oid):
-                with self._spill_lock:
-                    self._local_objects.pop(h, None)
+            if self.store.bytes_in_use() <= target_bytes:
+                return True
+            if not self._try_evict_one_locked(h, primary):
                 continue
-            if not primary:
-                # A pulled replica: another node holds the primary, so a
-                # plain delete is safe once the directory forgets us.
-                if self.store.delete(oid):
-                    with self._spill_lock:
-                        self._local_objects.pop(h, None)
-                    try:
-                        self.gcs.call("remove_object_location", h, self.node_id)
-                    except Exception:
-                        pass
-                    return True
-                continue  # pinned by a reader; try the next candidate
-            raw = self.store.get_raw(oid)
-            if raw is None:
-                with self._spill_lock:
-                    self._local_objects.pop(h, None)
-                continue
-            path = os.path.join(self._spill_dir, h)
-            try:
-                with open(path + ".tmp", "wb") as f:
-                    f.write(raw)
-                os.replace(path + ".tmp", path)
-            except OSError:
-                return False  # disk full/unwritable: stop spilling
+        return self.store.bytes_in_use() <= target_bytes
+
+    def _try_evict_one_locked(self, h: str, primary: bool) -> bool:
+        oid = ObjectID.from_hex(h)
+        if not self.store.contains(oid):
+            with self._spill_lock:
+                self._local_objects.pop(h, None)
+            return False
+        if not primary:
+            # A pulled replica: another node holds the primary, so a
+            # plain delete is safe once the directory forgets us.
             if self.store.delete(oid):
                 with self._spill_lock:
-                    self._spilled[h] = path
                     self._local_objects.pop(h, None)
+                try:
+                    self.gcs.call("remove_object_location", h, self.node_id)
+                except Exception:
+                    pass
                 return True
-            try:
-                os.unlink(path)  # pinned after all; keep the pool copy
-            except OSError:
-                pass
+            return False  # pinned by a reader
+        raw = self.store.get_raw(oid)
+        if raw is None:
+            with self._spill_lock:
+                self._local_objects.pop(h, None)
+            return False
+        path = os.path.join(self._spill_dir, h)
+        try:
+            with open(path + ".tmp", "wb") as f:
+                f.write(raw)
+            os.replace(path + ".tmp", path)
+        except OSError:
+            return False  # disk full/unwritable
+        if self.store.delete(oid):
+            with self._spill_lock:
+                self._spilled[h] = path
+                self._local_objects.pop(h, None)
+            return True
+        try:
+            os.unlink(path)  # pinned after all; keep the pool copy
+        except OSError:
+            pass
         return False
 
     def ensure_space(self, nbytes: int) -> bool:
         """Client-side ObjectStoreFullError escape hatch: make room for an
-        allocation of `nbytes` by evicting/spilling."""
+        allocation of `nbytes` — flush pending owner frees first (cheap),
+        evict/spill only for what remains."""
         target = max(0, int(self.store.capacity() * 0.95) - int(nbytes))
+        try:
+            self.gcs.call("flush_frees")
+        except Exception:
+            pass
+        if self.store.bytes_in_use() <= target:
+            return True
         return self._spill_to(target)
 
     def _restore(self, oid_hex: str) -> bool:
@@ -616,7 +675,9 @@ class RayletService:
         freed = 0
         for h in oid_hexes:
             oid = ObjectID.from_hex(h)
-            with self._spill_lock:
+            # _evict_lock: an in-flight spill of h must fully record its
+            # file before we decide what to clean up.
+            with self._evict_lock, self._spill_lock:
                 self._local_objects.pop(h, None)
                 spill_path = self._spilled.pop(h, None)
             if spill_path is not None:
@@ -687,17 +748,21 @@ class RayletService:
                         if a:
                             a["state"] = "DEAD"
                     self.gcs.call("actor_died", aid, "constructor failed", True)
+        self._sched_wake.set()  # freed worker/resources: dispatch more
         return True
 
     # --------------------------------------------------------- scheduling
     def _scheduler_loop(self) -> None:
         while not self._stop.is_set():
-            try:
-                entry = self._pending.get(timeout=0.05)
-            except queue.Empty:
-                entry = None
-            if entry is not None:
-                self._waiting.append(entry)
+            self._sched_wake.wait(timeout=0.05)
+            self._sched_wake.clear()
+            # Drain the whole burst: one entry per wakeup would make a
+            # 1k-task submission storm O(n^2) in scheduler scans.
+            while True:
+                try:
+                    self._waiting.append(self._pending.get_nowait())
+                except queue.Empty:
+                    break
             # Try to dispatch every waiting entry whose deps + resources are
             # ready (reference: local_task_manager.cc dispatch loop).
             still: List[dict] = []
@@ -825,7 +890,14 @@ class RayletService:
         for rid_hex in entry["return_ids"]:
             oid = ObjectID.from_hex(rid_hex.decode() if isinstance(rid_hex, bytes) else rid_hex)
             try:
-                self.store.put(oid, StoredError(error, entry.get("desc", "")))
+                err_obj = StoredError(error, entry.get("desc", ""))
+                try:
+                    self.store.put(oid, err_obj)
+                except exc.ObjectStoreFullError as e:
+                    # The error object MUST land or the caller's get() hangs
+                    # and mislabels the failure as object loss.
+                    self.ensure_space(e.nbytes)
+                    self.store.put(oid, err_obj)
                 sealed.append(oid.hex())
             except Exception:
                 pass
@@ -863,6 +935,7 @@ class RayletService:
                             entry["task_id"], "QUEUED", retry=entry["attempt"]
                         )
                         self._pending.put(entry)
+                        self._sched_wake.set()
                     else:
                         self._store_error_for(
                             entry,
@@ -925,7 +998,9 @@ class RayletService:
             with self._res_lock:
                 avail = dict(self.available)
             try:
-                self.gcs.call("heartbeat", self.node_id, avail)
+                reply = self.gcs.call("heartbeat", self.node_id, avail)
+                if isinstance(reply, dict):
+                    self._cluster_size = reply.get("nodes", self._cluster_size)
             except Exception:
                 pass
 

@@ -66,6 +66,15 @@ class RpcServer:
                     except (ConnectionError, OSError):
                         return
                     req_id, method, args, kwargs = pickle.loads(raw)
+                    if req_id is None:
+                        # One-way notification: execute without replying
+                        # (the submit fast path; errors surface as stored
+                        # error objects, not RPC failures).
+                        try:
+                            getattr(server_self.service, method)(*args, **kwargs)
+                        except BaseException:  # noqa: BLE001
+                            pass
+                        continue
                     try:
                         fn = getattr(server_self.service, method)
                         result = fn(*args, **kwargs)
@@ -158,6 +167,20 @@ class RpcClient:
         if not ok:
             raise result
         return result
+
+    def notify(self, method: str, *args, **kwargs) -> None:
+        """One-way call: no reply, no roundtrip wait (the analogue of the
+        reference's fire-and-forget task submission direction)."""
+        payload = pickle.dumps((None, method, args, kwargs))
+        sock = self._get_sock()
+        sock.settimeout(None)
+        try:
+            _send_msg(sock, payload)
+        except (ConnectionError, OSError):
+            sock.close()
+            sock = self._new_sock(5.0)
+            self._tls.sock = sock
+            _send_msg(sock, payload)
 
     def close(self):
         with self._all_lock:

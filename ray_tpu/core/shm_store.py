@@ -111,6 +111,12 @@ class SharedMemoryStore:
             self._map = mmap.mmap(fd, 0)
         finally:
             os.close(fd)
+        try:
+            # Hint the kernel to fault tmpfs pages in ahead of first write:
+            # cold-page faults during a large put() otherwise dominate.
+            self._map.madvise(mmap.MADV_WILLNEED)
+        except (AttributeError, OSError):
+            pass
         self._mv = memoryview(self._map)
         self._closed = False
 
@@ -250,16 +256,25 @@ class SharedMemoryStore:
         rc = self._lib.rtpu_delete(self._handle, oid.binary())
         return rc == 0
 
-    def put_with_pressure(self, oid: ObjectID, value: Any, raylet, deadline_s: float = 15.0) -> None:
+    def put_with_pressure(
+        self, oid: ObjectID, value: Any, raylet, deadline_s: float = 15.0, pre_pressure=None
+    ) -> None:
         """put() with bounded retry under pool pressure: asks the raylet to
         evict/spill and waits for readers to drop zero-copy pins (reference:
-        plasma's queued CreateRequest retries before ObjectStoreFullError)."""
+        plasma's queued CreateRequest retries before ObjectStoreFullError).
+        `pre_pressure` runs first (e.g. the owner flushing its pending frees
+        so eviction isn't asked to spill objects that are already dead)."""
         deadline = time.monotonic() + deadline_s
         while True:
             try:
                 self.put(oid, value)
                 return
             except exc.ObjectStoreFullError as e:
+                if pre_pressure is not None:
+                    try:
+                        pre_pressure()
+                    except Exception:
+                        pass
                 raylet.call("ensure_space", e.nbytes)
                 try:
                     self.put(oid, value)

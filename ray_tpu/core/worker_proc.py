@@ -82,7 +82,9 @@ def main(argv: List[str]) -> None:
                     f"task returned {len(values)} values, expected {len(rids)}"
                 )
         for rid, v in zip(rids, values):
-            store.put_with_pressure(rid, v, raylet)
+            store.put_with_pressure(
+                rid, v, raylet, pre_pressure=runtime.flush_local_frees
+            )
             sealed.append(rid.hex())
 
     def store_error(entry: dict, err: BaseException, sealed: List[str]) -> None:
@@ -91,7 +93,15 @@ def main(argv: List[str]) -> None:
         for h in entry["return_ids"]:
             rid = ObjectID.from_hex(h)
             try:
-                store.put(rid, StoredError(err, entry.get("desc", "")))
+                # Pressure-tolerant: a dropped error object turns a clean
+                # task failure into an apparent object loss at the caller.
+                store.put_with_pressure(
+                    rid,
+                    StoredError(err, entry.get("desc", "")),
+                    raylet,
+                    deadline_s=5.0,
+                    pre_pressure=runtime.flush_local_frees,
+                )
                 sealed.append(rid.hex())
             except Exception:
                 pass
@@ -156,9 +166,9 @@ def main(argv: List[str]) -> None:
             try:
                 ok = execute(entry, sealed)
             except SystemExit:
-                raylet.call("worker_done", worker_id, True, sealed)
+                raylet.notify("worker_done", worker_id, True, sealed)
                 return
-            raylet.call("worker_done", worker_id, ok, sealed)
+            raylet.notify("worker_done", worker_id, ok, sealed)
 
 
 if __name__ == "__main__":
