@@ -137,6 +137,27 @@ def _build_sched_options(opts: Dict[str, Any]) -> SchedulingOptions:
     bad = set(opts) - _VALID_OPTIONS
     if bad:
         raise ValueError(f"invalid option(s) {sorted(bad)}; valid: {sorted(_VALID_OPTIONS)}")
+    renv = opts.get("runtime_env")
+    if renv:
+        supported = {"env_vars", "working_dir"}
+        bad_env = set(renv) - supported
+        if bad_env:
+            # Honest surface: unsupported runtime-env fields raise instead
+            # of being silently dropped (reference: runtime_env validation,
+            # python/ray/_private/runtime_env/validation.py).
+            raise ValueError(
+                f"runtime_env field(s) {sorted(bad_env)} are not supported; "
+                f"supported: {sorted(supported)}"
+            )
+        ev = renv.get("env_vars")
+        if ev is not None and (
+            not isinstance(ev, dict)
+            or not all(isinstance(k, str) and isinstance(v, str) for k, v in ev.items())
+        ):
+            raise TypeError("runtime_env['env_vars'] must be a Dict[str, str]")
+        wd = renv.get("working_dir")
+        if wd is not None and not isinstance(wd, str):
+            raise TypeError("runtime_env['working_dir'] must be a path string")
     strategy = opts.get("scheduling_strategy", "DEFAULT")
     pg_id = None
     bundle_index = opts.get("placement_group_bundle_index", -1)

@@ -60,6 +60,8 @@ def _entry_from_spec(spec: TaskSpec) -> dict:
         "actor_id": spec.actor_id.hex() if spec.actor_id else None,
         "max_restarts": spec.options.max_restarts,
         "max_retries": spec.options.max_retries,
+        "max_concurrency": spec.options.max_concurrency,
+        "runtime_env": spec.options.runtime_env,
         "attempt": 0,
         "pg_id": spec.options.placement_group_id,
         "bundle_index": spec.options.bundle_index,
@@ -563,6 +565,43 @@ class ClusterRuntime(Runtime):
             self._actor_raylet(spec.actor_id).call("submit_actor_task", pickle.dumps(entry))
         return spec.return_ids
 
+    def cancel(self, object_id: ObjectID, force: bool = False) -> None:
+        """Cancels the task producing `object_id` (reference: worker.py
+        ray.cancel -> CoreWorker::CancelTask). Queued tasks are failed with
+        TaskCancelledError; running tasks are interrupted (force: worker
+        killed)."""
+        rec = self._records.get(object_id.hex())
+        if rec is None or rec.kind != "task":
+            raise ValueError(
+                "cancel() requires the ObjectRef of a submitted (non-actor) task"
+            )
+        tid = rec.entry["task_id"]
+        rec.entry["max_retries"] = 0  # a cancelled task must not be retried
+        # Task events are batch-flushed (~0.2s): wait briefly for the
+        # holding node to be known; if it stays unknown (early cancel of a
+        # forwarded task), broadcast to every alive raylet.
+        sock = None
+        deadline = time.monotonic() + 1.0
+        while True:
+            st = self._gcs.call("get_task_states", [tid]).get(tid)
+            if st is not None and st.get("node"):
+                node = self._gcs.call("node_info", st["node"])
+                if node is not None and node.get("alive"):
+                    sock = node["sock"]
+                break
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.05)
+        if sock is not None:
+            self._raylet_for(sock).call("cancel_task", tid, force)
+            return
+        for n in self._gcs.call("list_nodes"):
+            if n.get("Alive"):
+                try:
+                    self._raylet_for(n["sock"]).call("cancel_task", tid, force)
+                except Exception:
+                    pass
+
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         try:
             self._actor_raylet(actor_id).call("kill_actor", actor_id.hex(), no_restart)
@@ -666,10 +705,10 @@ class Cluster:
         self._shm_claimed = 0
         self._store_capacity = int(object_store_memory or CONFIG.object_store_memory)
 
-        gcs_proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.gcs", self.gcs_sock],
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
+        self.log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(self.log_dir, exist_ok=True)
+        gcs_proc = self._spawn_logged(
+            [sys.executable, "-m", "ray_tpu.core.gcs", self.gcs_sock], "gcs"
         )
         self._procs.append(gcs_proc)
         RpcClient(self.gcs_sock).call("ping")  # wait for boot
@@ -688,6 +727,18 @@ class Cluster:
         with open(os.path.join(self.session_dir, "session.json"), "w") as f:
             json.dump(info, f)
         atexit.register(self._cleanup)
+
+    def _spawn_logged(self, cmd: List[str], name: str) -> subprocess.Popen:
+        """Daemon stdout/stderr captured under <session>/logs (reference:
+        session_latest/logs in the reference; DEVNULLing them made any
+        daemon crash undiagnosable)."""
+        out = open(os.path.join(self.log_dir, f"{name}.out"), "ab", buffering=0)
+        err = open(os.path.join(self.log_dir, f"{name}.err"), "ab", buffering=0)
+        try:
+            return subprocess.Popen(cmd, stdout=out, stderr=err)
+        finally:
+            out.close()
+            err.close()
 
     def _sock_for(self, node_id: str) -> str:
         return os.path.join(self.session_dir, f"raylet_{node_id}.sock")
@@ -722,7 +773,7 @@ class Cluster:
         if num_cpus is not None:
             res["CPU"] = float(num_cpus)
         res.setdefault("CPU", 1.0)
-        proc = subprocess.Popen(
+        proc = self._spawn_logged(
             [
                 sys.executable,
                 "-m",
@@ -734,8 +785,7 @@ class Cluster:
                 json.dumps(res),
                 str(self._store_capacity),
             ],
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
+            f"raylet_{node_id}",
         )
         self._procs.append(proc)
         self._node_procs[node_id] = proc

@@ -348,6 +348,12 @@ class LocalRuntime(Runtime):
         )
         return spec.return_ids
 
+    def cancel(self, object_id: ObjectID, force: bool = False) -> None:
+        # Honest surface: thread-pool tasks cannot be interrupted safely.
+        raise NotImplementedError(
+            "cancel() is not supported in local mode; use cluster mode"
+        )
+
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         with self._actor_lock:
             state = self._actors.get(actor_id)

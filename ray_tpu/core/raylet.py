@@ -17,9 +17,11 @@ directory before dispatch)."""
 from __future__ import annotations
 
 import collections
+import json
 import os
 import pickle
 import queue
+import signal
 import subprocess
 import sys
 import threading
@@ -38,12 +40,14 @@ POLL_TIMEOUT_S = CONFIG.worker_poll_timeout_s
 
 
 class _Worker:
-    def __init__(self, worker_id: str, proc: subprocess.Popen):
+    def __init__(self, worker_id: str, proc: subprocess.Popen, env_key: str = ""):
         self.worker_id = worker_id
         self.proc = proc
         self.mailbox: "queue.Queue" = queue.Queue()
         self.busy_with: Optional[dict] = None  # task entry being executed
         self.actor_id: Optional[str] = None  # dedicated actor worker
+        self.env_key = env_key  # runtime-env pool key (reference:
+        # worker_pool.h PopWorker matching runtime_env_hash)
 
 
 class RayletService:
@@ -73,9 +77,12 @@ class RayletService:
         self._bundles: Dict[Tuple[str, int], dict] = {}
 
         self._workers: Dict[str, _Worker] = {}
-        self._idle: List[str] = []
+        self._idle: Dict[str, List[str]] = {}  # env_key -> idle worker ids
         self._workers_lock = threading.Lock()
         self._max_task_workers = max(1, int(resources.get("CPU", 1)))
+        # Task ids cancelled before dispatch (reference: core_worker
+        # CancelTask -> raylet queued-task removal).
+        self._cancelled: Set[str] = set()
 
         self._pending: "queue.Queue" = queue.Queue()  # task entries
         # Wakes the dispatch loop on any schedulability change (new task,
@@ -117,6 +124,8 @@ class RayletService:
             os.path.dirname(sock_path) or ".", f"spill_{node_id}"
         )
         os.makedirs(self._spill_dir, exist_ok=True)
+        self._log_dir = os.path.join(os.path.dirname(sock_path) or ".", "logs")
+        os.makedirs(self._log_dir, exist_ok=True)
         self._local_objects: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
         self._spilled: Dict[str, str] = {}
         self._spill_lock = threading.Lock()
@@ -362,6 +371,43 @@ class RayletService:
         self._store_error_for(
             entry, RuntimeError(f"no node can satisfy {resources}")
         )
+
+    def cancel_task(self, task_id: str, force: bool = False) -> bool:
+        """Cancels a queued or running normal task (reference: core_worker
+        CancelTask; queued removal + SIGINT/kill of the executor). Returns
+        True if the task was found here."""
+        # Queued: remove from the waiting list via the scheduler's next scan.
+        with self._workers_lock:
+            running = next(
+                (
+                    w
+                    for w in self._workers.values()
+                    if w.busy_with is not None
+                    and w.busy_with.get("task_id") == task_id
+                ),
+                None,
+            )
+        if running is None:
+            self._cancelled.add(task_id)
+            self._sched_wake.set()
+            return True
+        entry = running.busy_with
+        # Sticky intent: if the signalled worker dies instead of catching
+        # the interrupt (e.g. SIGINT during startup imports), the monitor
+        # must cancel, not retry.
+        self._cancelled.add(task_id)
+        if force:
+            running.proc.kill()
+            self._store_error_for(
+                entry,
+                exc.TaskCancelledError(f"{entry.get('desc','task')} was cancelled"),
+            )
+        else:
+            try:
+                running.proc.send_signal(signal.SIGINT)
+            except OSError:
+                pass
+        return True
 
     def _can_run_soon(self, resources) -> bool:
         with self._res_lock:
@@ -706,12 +752,20 @@ class RayletService:
         except queue.Empty:
             return {"type": "noop"}
 
-    def worker_done(self, worker_id: str, ok: bool, sealed: Optional[List[str]] = None) -> bool:
+    def worker_done(
+        self,
+        worker_id: str,
+        ok: bool,
+        sealed: Optional[List[str]] = None,
+        task_id: Optional[str] = None,
+    ) -> bool:
         if sealed:
             # The task's return objects: wake local waiters + batch the
             # directory update (folded into this RPC so completion costs one
             # round trip, not one per return object).
             self._notify_sealed(sealed)
+        if task_id is not None:
+            self._cancelled.discard(task_id)
         with self._workers_lock:
             w = self._workers.get(worker_id)
             if w is None:
@@ -719,17 +773,28 @@ class RayletService:
             entry = w.busy_with
             w.busy_with = None
             if w.actor_id is None:
-                self._idle.append(worker_id)
+                self._idle.setdefault(w.env_key, []).append(worker_id)
         if w.actor_id is not None and entry is None:
-            # Serial actor execution: the completed task is the oldest
-            # in-flight entry.
+            # Actor task completion: remove the matching in-flight entry
+            # (by task id — concurrent actors complete out of order).
             with self._actor_lock:
                 a = self._actors.get(w.actor_id)
                 if a and a["inflight"]:
-                    done = a["inflight"].pop(0)
-                    self._task_event(
-                        done["task_id"], "FINISHED" if ok else "FAILED"
-                    )
+                    idx = 0
+                    if task_id is not None:
+                        idx = next(
+                            (
+                                i
+                                for i, e in enumerate(a["inflight"])
+                                if e["task_id"] == task_id
+                            ),
+                            None,
+                        )
+                    if idx is not None:
+                        done = a["inflight"].pop(idx)
+                        self._task_event(
+                            done["task_id"], "FINISHED" if ok else "FAILED"
+                        )
         if entry is not None:
             self._task_event(entry["task_id"], "FINISHED" if ok else "FAILED")
             if entry["type"] == "task":
@@ -764,14 +829,22 @@ class RayletService:
                 except queue.Empty:
                     break
             # Try to dispatch every waiting entry whose deps + resources are
-            # ready (reference: local_task_manager.cc dispatch loop).
+            # ready (reference: local_task_manager.cc dispatch loop). One
+            # malformed entry must not kill the scheduler thread (that
+            # bricks the node): fail the entry instead.
             still: List[dict] = []
             for e in self._waiting:
-                if not self._deps_ready(e):
-                    still.append(e)
-                    continue
-                if not self._dispatch(e):
-                    still.append(e)
+                try:
+                    if not self._deps_ready(e):
+                        still.append(e)
+                        continue
+                    if not self._dispatch(e):
+                        still.append(e)
+                except Exception as sched_err:  # noqa: BLE001
+                    try:
+                        self._store_error_for(e, sched_err)
+                    except Exception:
+                        pass
             self._waiting = still
 
     def _deps_ready(self, entry: dict) -> bool:
@@ -787,12 +860,21 @@ class RayletService:
 
     def _dispatch(self, entry: dict) -> bool:
         kind = entry["type"]
+        if entry.get("task_id") in self._cancelled:
+            self._cancelled.discard(entry["task_id"])
+            self._store_error_for(
+                entry,
+                exc.TaskCancelledError(
+                    f"{entry.get('desc','task')} was cancelled before dispatch"
+                ),
+            )
+            return True
         if kind == "task":
             if self._fail_if_unschedulable(entry):
                 return True
             if not self._try_acquire_entry(entry):
                 return False
-            w = self._checkout_worker()
+            w = self._checkout_worker(self._env_key(entry))
             if w is None:
                 self._release_entry(entry)
                 return False
@@ -812,7 +894,11 @@ class RayletService:
                 return True
             if not self._try_acquire_entry(entry):
                 return False
-            w = self._spawn_worker(actor_id=entry["actor_id"])
+            w = self._spawn_worker(
+                actor_id=entry["actor_id"],
+                env_key=self._env_key(entry),
+                runtime_env=entry.get("runtime_env"),
+            )
             with self._actor_lock:
                 a = self._actors.get(entry["actor_id"])
                 if a is not None:
@@ -844,42 +930,82 @@ class RayletService:
             return True
         return True
 
-    def _checkout_worker(self) -> Optional[_Worker]:
+    @staticmethod
+    def _env_key(entry: dict) -> str:
+        renv = entry.get("runtime_env")
+        if not renv:
+            return ""
+        return json.dumps(renv, sort_keys=True)
+
+    def _checkout_worker(self, env_key: str = "") -> Optional[_Worker]:
         with self._workers_lock:
-            while self._idle:
-                wid = self._idle.pop()
+            idle = self._idle.setdefault(env_key, [])
+            while idle:
+                wid = idle.pop()
                 w = self._workers.get(wid)
                 if w is not None and w.proc.poll() is None:
                     return w
             n_task_workers = sum(1 for w in self._workers.values() if w.actor_id is None)
             if n_task_workers < self._max_task_workers:
-                return self._spawn_worker_locked()
+                return self._spawn_worker_locked(env_key=env_key)
+            # At the cap with only mismatched-env idle workers: retire one
+            # and spawn for this env (reference: worker_pool killing idle
+            # workers with stale runtime envs).
+            for k, lst in self._idle.items():
+                if k != env_key and lst:
+                    wid = lst.pop()
+                    old = self._workers.pop(wid, None)
+                    if old is not None:
+                        old.mailbox.put({"type": "stop"})
+                    return self._spawn_worker_locked(env_key=env_key)
         return None
 
-    def _spawn_worker(self, actor_id: Optional[str] = None) -> _Worker:
+    def _spawn_worker(
+        self, actor_id: Optional[str] = None, env_key: str = "", runtime_env=None
+    ) -> _Worker:
         with self._workers_lock:
-            return self._spawn_worker_locked(actor_id)
+            return self._spawn_worker_locked(actor_id, env_key, runtime_env)
 
-    def _spawn_worker_locked(self, actor_id: Optional[str] = None) -> _Worker:
+    def _spawn_worker_locked(
+        self, actor_id: Optional[str] = None, env_key: str = "", runtime_env=None
+    ) -> _Worker:
         worker_id = uuid.uuid4().hex[:12]
         env = dict(os.environ)
         env["RAY_TPU_WORKER"] = "1"
-        proc = subprocess.Popen(
-            [
-                sys.executable,
-                "-m",
-                "ray_tpu.core.worker_proc",
-                self.sock_path,
-                self.store_path,
-                self.gcs_sock,
-                worker_id,
-                self.node_id,
-            ],
-            env=env,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
-        )
-        w = _Worker(worker_id, proc)
+        if env_key and runtime_env is None:
+            runtime_env = json.loads(env_key)
+        if runtime_env:
+            # Apply env_vars at spawn; working_dir is applied by the worker
+            # itself (reference: runtime_env_agent building the env).
+            for k, v in (runtime_env.get("env_vars") or {}).items():
+                env[str(k)] = str(v)
+            env["RAY_TPU_RUNTIME_ENV"] = json.dumps(runtime_env)
+        # Worker stdout/stderr land in per-process session log files
+        # (reference: worker-<id>-out/err under the session's logs dir) —
+        # a user print inside a task must be recoverable.
+        log_base = os.path.join(self._log_dir, f"worker_{worker_id}")
+        out_f = open(log_base + ".out", "ab", buffering=0)
+        err_f = open(log_base + ".err", "ab", buffering=0)
+        try:
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "ray_tpu.core.worker_proc",
+                    self.sock_path,
+                    self.store_path,
+                    self.gcs_sock,
+                    worker_id,
+                    self.node_id,
+                ],
+                env=env,
+                stdout=out_f,
+                stderr=err_f,
+            )
+        finally:
+            out_f.close()
+            err_f.close()
+        w = _Worker(worker_id, proc, env_key=env_key)
         w.actor_id = actor_id
         self._workers[worker_id] = w
         return w
@@ -915,15 +1041,24 @@ class RayletService:
                     if w.proc.poll() is not None:
                         dead.append(w)
                         del self._workers[w.worker_id]
-                        if w.worker_id in self._idle:
-                            self._idle.remove(w.worker_id)
+                        idle_list = self._idle.get(w.env_key)
+                        if idle_list and w.worker_id in idle_list:
+                            idle_list.remove(w.worker_id)
             for w in dead:
                 entry = w.busy_with
                 if entry is not None:
                     if entry["type"] == "task":
                         self._release_entry(entry)
                     mr = entry.get("max_retries", 0)
-                    if entry["type"] == "task" and (
+                    if entry.get("task_id") in self._cancelled:
+                        self._cancelled.discard(entry["task_id"])
+                        self._store_error_for(
+                            entry,
+                            exc.TaskCancelledError(
+                                f"{entry.get('desc','task')} was cancelled"
+                            ),
+                        )
+                    elif entry["type"] == "task" and (
                         mr < 0 or mr - entry.get("attempt", 0) > 0
                     ):
                         # Raylet-side retry on worker death (reference:

@@ -7,12 +7,28 @@ execution callback _raylet.pyx:1698 execute_task). The worker owns a full
 Runtime (ClusterRuntime in worker mode), so user tasks can themselves
 submit tasks, create actors, and call get/put — nested remote calls work
 exactly as on the driver.
+
+Actor concurrency (reference: actor_scheduling_queue.h,
+concurrency_group_manager.h, fiber.h async actors): an actor created with
+max_concurrency > 1 executes its methods on a thread pool of that width;
+an actor with coroutine methods runs them on a dedicated asyncio event
+loop (max_concurrency concurrent coroutines). Completion is reported
+per-task to the raylet, which tracks in-flight entries by task id.
+
+Runtime envs: the raylet spawns this process with RAY_TPU_RUNTIME_ENV
+(env_vars already applied to our environment by the spawner; working_dir
+applied here as cwd + sys.path entry — reference:
+_private/runtime_env/working_dir.py).
 """
 
 from __future__ import annotations
 
+import inspect
+import json
+import os
+import signal
 import sys
-import traceback
+import threading
 from typing import Any, Dict, List, Optional
 
 import cloudpickle
@@ -48,6 +64,41 @@ def _resolve_args(store, args_blob: bytes, raylet=None):
     return tuple(fetch(a) for a in args), {k: fetch(v) for k, v in kwargs.items()}
 
 
+def _apply_working_dir(runtime_env: dict) -> None:
+    wd = (runtime_env or {}).get("working_dir")
+    if wd:
+        os.chdir(wd)
+        sys.path.insert(0, wd)
+
+
+class _AsyncLoop:
+    """A dedicated asyncio event loop thread for async actors
+    (reference: fiber.h / async actor event loop in _raylet.pyx)."""
+
+    def __init__(self, concurrency: int):
+        import asyncio
+
+        self._asyncio = asyncio
+        self.loop = asyncio.new_event_loop()
+        self.sem = None
+        self.concurrency = concurrency
+        t = threading.Thread(target=self._run, daemon=True, name="actor-aio")
+        t.start()
+
+    def _run(self):
+        self._asyncio.set_event_loop(self.loop)
+        self.sem = self._asyncio.Semaphore(self.concurrency)
+        self.loop.run_forever()
+
+    def submit(self, coro_fn, done_cb):
+        async def wrapped():
+            async with self.sem:
+                return await coro_fn()
+
+        fut = self._asyncio.run_coroutine_threadsafe(wrapped(), self.loop)
+        fut.add_done_callback(done_cb)
+
+
 def main(argv: List[str]) -> None:
     raylet_sock, store_path, gcs_sock, worker_id, node_id = argv
 
@@ -57,6 +108,9 @@ def main(argv: List[str]) -> None:
     from .object_transport import StoredError
     from .rpc import RpcClient
     from .shm_store import SharedMemoryStore
+
+    runtime_env = json.loads(os.environ.get("RAY_TPU_RUNTIME_ENV", "{}") or "{}")
+    _apply_working_dir(runtime_env)
 
     store = SharedMemoryStore(store_path)
     raylet = RpcClient(raylet_sock)
@@ -70,6 +124,16 @@ def main(argv: List[str]) -> None:
     runtime_base.set_runtime(runtime)
 
     actor_instance: Dict[str, Any] = {}  # actor_id -> instance
+
+    # ----- cancellation: SIGINT interrupts the CURRENT main-thread task ---
+    executing_main = threading.Event()
+
+    def _sigint(signum, frame):
+        if executing_main.is_set():
+            raise KeyboardInterrupt
+        # Idle / between tasks: ignore (a late cancel for a finished task).
+
+    signal.signal(signal.SIGINT, _sigint)
 
     def store_returns(entry: dict, result: Any, sealed: List[str]) -> None:
         rids = [ObjectID.from_hex(h) for h in entry["return_ids"]]
@@ -106,26 +170,19 @@ def main(argv: List[str]) -> None:
             except Exception:
                 pass
 
-    def execute(entry: dict, sealed: List[str]) -> bool:
+    def run_body(entry: dict, sealed: List[str]) -> bool:
+        """Executes one entry body synchronously (any thread)."""
         kind = entry["type"]
         try:
             if kind == "task":
                 fn = GLOBAL_FUNCTION_TABLE.loads(entry["func_blob"], entry["func_hash"])
                 args, kwargs = _resolve_args(store, entry["args_blob"], raylet)
                 result = fn(*args, **kwargs)
-                import inspect
-
                 if inspect.iscoroutine(result):
                     import asyncio
 
                     result = asyncio.run(result)
                 store_returns(entry, result, sealed)
-                return True
-            if kind == "actor_creation":
-                cls = GLOBAL_FUNCTION_TABLE.loads(entry["func_blob"], entry["func_hash"])
-                args, kwargs = _resolve_args(store, entry["args_blob"], raylet)
-                actor_instance[entry["actor_id"]] = cls(*args, **kwargs)
-                store_returns(entry, None, sealed)
                 return True
             if kind == "actor_task":
                 inst = actor_instance.get(entry["actor_id"])
@@ -134,8 +191,6 @@ def main(argv: List[str]) -> None:
                 method = getattr(inst, entry["method_name"])
                 args, kwargs = _resolve_args(store, entry["args_blob"], raylet)
                 result = method(*args, **kwargs)
-                import inspect
-
                 if inspect.iscoroutine(result):
                     import asyncio
 
@@ -146,9 +201,102 @@ def main(argv: List[str]) -> None:
         except SystemExit:
             store_returns(entry, None, sealed)
             raise
+        except KeyboardInterrupt:
+            store_error(
+                entry,
+                exc.TaskCancelledError(f"{entry.get('desc','task')} was cancelled"),
+                sealed,
+            )
+            return False
         except BaseException as e:  # noqa: BLE001
             store_error(entry, e, sealed)
             return False
+
+    def done(entry: dict, ok: bool, sealed: List[str]) -> None:
+        raylet.notify("worker_done", worker_id, ok, sealed, entry.get("task_id"))
+
+    # ----- concurrent actor executors -------------------------------------
+    pool: Optional[Any] = None  # ThreadPoolExecutor for threaded actors
+    aio: Optional[_AsyncLoop] = None
+
+    def create_actor(entry: dict, sealed: List[str]) -> bool:
+        nonlocal pool, aio
+        try:
+            cls = GLOBAL_FUNCTION_TABLE.loads(entry["func_blob"], entry["func_hash"])
+            args, kwargs = _resolve_args(store, entry["args_blob"], raylet)
+            inst = cls(*args, **kwargs)
+            actor_instance[entry["actor_id"]] = inst
+            mc = int(entry.get("max_concurrency", 1) or 1)
+            # Scan the CLASS, not the instance: getattr on the instance
+            # would execute @property getters during creation.
+            has_async = any(
+                inspect.iscoroutinefunction(getattr(type(inst), m, None))
+                for m in dir(type(inst))
+                if not m.startswith("_")
+            )
+            if has_async:
+                aio = _AsyncLoop(max(1, mc))
+            elif mc > 1:
+                import concurrent.futures
+
+                pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=mc, thread_name_prefix="actor"
+                )
+            store_returns(entry, None, sealed)
+            return True
+        except SystemExit:
+            store_returns(entry, None, sealed)
+            raise
+        except BaseException as e:  # noqa: BLE001
+            store_error(entry, e, sealed)
+            return False
+
+    def exec_actor_task_async(entry: dict) -> None:
+        """Runs an async actor method on the event loop."""
+        inst = actor_instance.get(entry["actor_id"])
+
+        async def coro():
+            import asyncio
+
+            # Arg resolution can block (remote/spilled deps): keep it off
+            # the event loop thread or all concurrent coroutines stall.
+            args, kwargs = await asyncio.get_running_loop().run_in_executor(
+                None, _resolve_args, store, entry["args_blob"], raylet
+            )
+            method = getattr(inst, entry["method_name"])
+            result = method(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            return result
+
+        def on_done(fut):
+            sealed: List[str] = []
+            try:
+                result = fut.result()
+                store_returns(entry, result, sealed)
+                done(entry, True, sealed)
+            except SystemExit:
+                store_returns(entry, None, sealed)
+                done(entry, True, sealed)
+                os._exit(0)
+            except BaseException as e:  # noqa: BLE001
+                store_error(entry, e, sealed)
+                done(entry, False, sealed)
+
+        aio.submit(coro, on_done)
+
+    def exec_threaded(entry: dict) -> None:
+        def run():
+            sealed: List[str] = []
+            try:
+                ok = run_body(entry, sealed)
+            except SystemExit:
+                done(entry, True, sealed)
+                os._exit(0)
+                return
+            done(entry, ok, sealed)
+
+        pool.submit(run)
 
     while True:
         try:
@@ -162,13 +310,34 @@ def main(argv: List[str]) -> None:
             continue
         if kind == "task":
             entry = msg["entry"]
-            sealed: List[str] = []
+            if entry["type"] == "actor_creation":
+                sealed: List[str] = []
+                try:
+                    ok = create_actor(entry, sealed)
+                except SystemExit:
+                    done(entry, True, sealed)
+                    return
+                done(entry, ok, sealed)
+                continue
+            if entry["type"] == "actor_task" and aio is not None:
+                exec_actor_task_async(entry)
+                continue
+            if entry["type"] == "actor_task" and pool is not None:
+                exec_threaded(entry)
+                continue
+            # Serial path (normal tasks + max_concurrency=1 actors): runs in
+            # the main thread so cancel-via-SIGINT can interrupt it.
+            sealed = []
+            executing_main.set()
             try:
-                ok = execute(entry, sealed)
+                ok = run_body(entry, sealed)
             except SystemExit:
-                raylet.notify("worker_done", worker_id, True, sealed)
+                executing_main.clear()
+                done(entry, True, sealed)
                 return
-            raylet.notify("worker_done", worker_id, ok, sealed)
+            finally:
+                executing_main.clear()
+            done(entry, ok, sealed)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,165 @@
+"""Honest scheduling options on the CLUSTER path: max_concurrency
+(threaded + async actors), cancel(), runtime_env (env_vars/working_dir).
+max_retries is covered by tests/test_recovery.py.
+
+Reference: actor_scheduling_queue.h / concurrency_group_manager.h /
+fiber.h (concurrency), core_worker CancelTask, runtime_env agent."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import exceptions as exc
+
+
+@pytest.fixture
+def rt_cluster():
+    rt.shutdown()
+    rt.init(num_cpus=4, num_workers=2)
+    yield rt
+    rt.shutdown()
+
+
+def test_threaded_actor_max_concurrency(rt_cluster):
+    @rt.remote(max_concurrency=4)
+    class Sleeper:
+        def nap(self, t):
+            time.sleep(t)
+            return os.getpid()
+
+    a = Sleeper.remote()
+    rt.get(a.nap.remote(0.01), timeout=60)  # wait out worker spawn/imports
+    t0 = time.monotonic()
+    refs = [a.nap.remote(0.5) for _ in range(4)]
+    pids = rt.get(refs, timeout=30)
+    elapsed = time.monotonic() - t0
+    # Serial execution would take >= 2s; concurrent should be ~0.5s.
+    assert elapsed < 1.5, f"naps did not overlap: {elapsed:.2f}s"
+    assert len(set(pids)) == 1  # all in the one actor process
+
+
+def test_async_actor_concurrency(rt_cluster):
+    @rt.remote(max_concurrency=8)
+    class AsyncActor:
+        async def nap(self, t):
+            import asyncio
+
+            await asyncio.sleep(t)
+            return "done"
+
+    a = AsyncActor.remote()
+    rt.get(a.nap.remote(0.01), timeout=60)  # wait out worker spawn/imports
+    t0 = time.monotonic()
+    out = rt.get([a.nap.remote(0.5) for _ in range(8)], timeout=30)
+    elapsed = time.monotonic() - t0
+    assert out == ["done"] * 8
+    assert elapsed < 2.0, f"async naps did not overlap: {elapsed:.2f}s"
+
+
+def test_cancel_running_task(rt_cluster):
+    @rt.remote
+    def warm():
+        return 1
+
+    rt.get(warm.remote(), timeout=60)  # worker pool up
+
+    @rt.remote
+    def sleeper():
+        time.sleep(60)
+        return "never"
+
+    ref = sleeper.remote()
+    time.sleep(1.0)  # let it dispatch
+    rt.cancel(ref)
+    with pytest.raises(exc.TaskCancelledError):
+        rt.get(ref, timeout=15)
+
+
+def test_cancel_queued_task(rt_cluster):
+    @rt.remote(num_cpus=4)
+    def hog():
+        time.sleep(3)
+        return "hogged"
+
+    @rt.remote(num_cpus=4)
+    def queued():
+        return "ran"
+
+    h = hog.remote()
+    q = queued.remote()  # cannot start while hog holds all CPUs
+    time.sleep(0.3)
+    rt.cancel(q)
+    with pytest.raises(exc.TaskCancelledError):
+        rt.get(q, timeout=15)
+    assert rt.get(h, timeout=30) == "hogged"
+
+
+def test_cancel_force_kills_worker(rt_cluster):
+    @rt.remote
+    def warm():
+        return 1
+
+    rt.get(warm.remote(), timeout=60)
+
+    @rt.remote
+    def stubborn():
+        while True:  # ignores SIGINT-based cancellation paths
+            try:
+                time.sleep(60)
+            except KeyboardInterrupt:
+                continue
+
+    ref = stubborn.remote()
+    time.sleep(1.0)
+    rt.cancel(ref, force=True)
+    with pytest.raises((exc.TaskCancelledError, exc.WorkerCrashedError)):
+        rt.get(ref, timeout=20)
+
+
+def test_runtime_env_env_vars(rt_cluster):
+    @rt.remote(runtime_env={"env_vars": {"MY_FLAG": "hello"}})
+    def read_env():
+        return os.environ.get("MY_FLAG")
+
+    @rt.remote
+    def read_plain():
+        return os.environ.get("MY_FLAG")
+
+    assert rt.get(read_env.remote(), timeout=30) == "hello"
+    assert rt.get(read_plain.remote(), timeout=30) is None
+
+
+def test_runtime_env_working_dir(rt_cluster, tmp_path):
+    mod = tmp_path / "wd_module.py"
+    mod.write_text("VALUE = 'from-working-dir'\n")
+
+    @rt.remote(runtime_env={"working_dir": str(tmp_path)})
+    def use_module():
+        import wd_module
+
+        return wd_module.VALUE, os.getcwd()
+
+    value, cwd = rt.get(use_module.remote(), timeout=30)
+    assert value == "from-working-dir"
+    assert cwd == str(tmp_path)
+
+
+def test_runtime_env_actor(rt_cluster):
+    @rt.remote(runtime_env={"env_vars": {"ACTOR_ENV": "yes"}})
+    class EnvActor:
+        def read(self):
+            return os.environ.get("ACTOR_ENV")
+
+    a = EnvActor.remote()
+    assert rt.get(a.read.remote(), timeout=30) == "yes"
+
+
+def test_runtime_env_unsupported_field_raises(rt_cluster):
+    @rt.remote(runtime_env={"pip": ["requests"]})
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="not supported"):
+        f.remote()
