@@ -767,6 +767,7 @@ class Cluster:
         num_cpus: Optional[float] = None,
         resources: Optional[Dict[str, float]] = None,
         num_workers: Optional[int] = None,
+        labels: Optional[Dict[str, Any]] = None,
     ) -> str:
         node_id = uuid.uuid4().hex[:12]
         res = dict(resources or {})
@@ -784,6 +785,7 @@ class Cluster:
                 self.gcs_sock,
                 json.dumps(res),
                 str(self._store_capacity),
+                json.dumps(labels or {}),
             ],
             f"raylet_{node_id}",
         )

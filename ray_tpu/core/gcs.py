@@ -48,6 +48,7 @@ class GcsService:
         # reference_count.h WaitForRefRemoved): an owner's free is deferred
         # while borrowers hold the ref, and a freed object that seals late
         # (free raced the task) is deleted on arrival.
+        self._removed_pgs: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
         self._borrows: Dict[str, int] = {}
         self._deferred_free: Set[str] = set()
         self._free_queue: List[Tuple[float, List[str]]] = []
@@ -58,17 +59,37 @@ class GcsService:
         self._health.start()
 
     # ------------------------------------------------------------- nodes
-    def register_node(self, node_id: str, sock_path: str, store_path: str, resources: dict) -> dict:
+    def register_node(
+        self,
+        node_id: str,
+        sock_path: str,
+        store_path: str,
+        resources: dict,
+        labels: Optional[dict] = None,
+    ) -> dict:
         with self._lock:
             self._nodes[node_id] = {
                 "sock": sock_path,
                 "store": store_path,
                 "resources": dict(resources),
                 "available": dict(resources),
+                "labels": dict(labels or {}),
                 "alive": True,
                 "last_hb": time.monotonic(),
             }
-            return {"ok": True, "nodes": sum(1 for n in self._nodes.values() if n["alive"])}
+            n_alive = sum(1 for n in self._nodes.values() if n["alive"])
+            retry_gangs = [
+                pg_id
+                for pg_id, pg in self._pgs.items()
+                if pg.get("state") == "RESCHEDULING"
+            ]
+        if retry_gangs:
+            # A new host may complete a slice: retry stranded gangs.
+            threading.Thread(
+                target=lambda: [self._reschedule_gang(p) for p in retry_gangs],
+                daemon=True,
+            ).start()
+        return {"ok": True, "nodes": n_alive}
 
     def heartbeat(self, node_id: str, available: dict) -> dict:
         with self._lock:
@@ -146,8 +167,21 @@ class GcsService:
         return best
 
     def _health_loop(self):
+        tick = 0
         while not self._stop.wait(0.1):
             self._process_frees()
+            tick += 1
+            if tick % 20 == 0:
+                # Stranded gangs retry when capacity frees up, not only on
+                # node registration.
+                with self._lock:
+                    stranded = [
+                        pg_id
+                        for pg_id, pg in self._pgs.items()
+                        if pg.get("state") == "RESCHEDULING"
+                    ]
+                for pg_id in stranded:
+                    self._reschedule_gang(pg_id)
             dead = []
             with self._lock:
                 for nid, n in self._nodes.items():
@@ -160,7 +194,23 @@ class GcsService:
     def _on_node_death(self, node_id: str) -> None:
         """Node failure: objects there are lost from the directory; actors
         become restart candidates (reference: gcs_node_manager death
-        handling -> gcs_actor_manager restart :548)."""
+        handling -> gcs_actor_manager restart :548); SLICE_GANG groups with
+        a member on the dead node co-fail and reschedule atomically."""
+        gangs: List[str] = []
+        with self._lock:
+            for pg_id, pg in self._pgs.items():
+                if (
+                    pg["strategy"] == "SLICE_GANG"
+                    and node_id in pg["placements"]
+                    and pg.get("state") == "CREATED"
+                ):
+                    pg["state"] = "RESCHEDULING"
+                    gangs.append(pg_id)
+        if gangs:
+            threading.Thread(
+                target=lambda: [self._reschedule_gang(p) for p in gangs],
+                daemon=True,
+            ).start()
         with self._lock:
             n = self._nodes.get(node_id)
             if n is not None:
@@ -508,6 +558,8 @@ class GcsService:
         """Pure placement planning against the current resource view
         (reference: bundle_scheduling_policy.h PACK/SPREAD/STRICT_PACK/
         STRICT_SPREAD + the TPU-native SLICE_GANG)."""
+        if strategy == "SLICE_GANG":
+            return self._plan_slice_gang(bundles, banned)
         placements: List[str] = []
         with self._lock:
             avail = {
@@ -537,7 +589,7 @@ class GcsService:
                         if fits(nid, bundle):
                             chosen = nid
                             break
-            elif strategy in ("SPREAD", "STRICT_SPREAD", "SLICE_GANG"):
+            elif strategy in ("SPREAD", "STRICT_SPREAD"):
                 used = set(placements)
                 candidates = [n for n in order if n not in used] or (
                     order if strategy == "SPREAD" else []
@@ -554,12 +606,83 @@ class GcsService:
             placements.append(chosen)
         return placements
 
+    def _plan_slice_gang(self, bundles: List[dict], banned: Set[str]) -> List[str]:
+        """SLICE_GANG: all bundles land on hosts of ONE named TPU slice, or
+        the gang fails — an SPMD program must see its full mesh (reference:
+        the TPU-{pod}-head idiom at _private/accelerators/tpu.py:334-397 and
+        bundle_scheduling_policy.h:82-106, redesigned as a first-class
+        atomic policy over registered TpuSliceSpecs)."""
+        with self._lock:
+            slices: Dict[str, List[Tuple[int, str, dict]]] = {}
+            for nid, n in self._nodes.items():
+                if not n["alive"] or nid in banned:
+                    continue
+                sl = (n.get("labels") or {}).get("slice_name")
+                if not sl:
+                    continue
+                widx = int((n.get("labels") or {}).get("worker_index", 0))
+                slices.setdefault(sl, []).append((widx, nid, dict(n["available"])))
+        # Smallest slice that fits first: don't fragment big slices.
+        for sl in sorted(slices, key=lambda s: (len(slices[s]), s)):
+            hosts = sorted(slices[sl])
+            avail = {nid: dict(av) for _, nid, av in hosts}
+            order = [nid for _, nid, _ in hosts]
+            placements: List[str] = []
+            for bundle in bundles:
+                chosen = None
+                for j in range(len(order)):
+                    nid = order[(len(placements) + j) % len(order)]
+                    if all(avail[nid].get(k, 0.0) >= v for k, v in bundle.items()):
+                        chosen = nid
+                        break
+                if chosen is None:
+                    break
+                for k, v in bundle.items():
+                    avail[chosen][k] = avail[chosen].get(k, 0.0) - v
+                placements.append(chosen)
+            if len(placements) == len(bundles):
+                return placements
+        raise RuntimeError(
+            f"no registered TPU slice can host all {len(bundles)} bundles atomically"
+        )
+
+    def _reschedule_gang(self, pg_id: str) -> None:
+        """A gang member died: release every sibling lease (bundle-pinned
+        work fails fast on its raylet) and re-place the WHOLE gang on
+        another slice (no partial restarts)."""
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            if pg is None or pg.get("state") != "RESCHEDULING":
+                return
+            pg["state"] = "REPLANNING"  # CAS: one rescheduler at a time
+            placements = list(pg["placements"])
+            bundles = pg["bundles"]
+        for i, nid in enumerate(placements):
+            with self._lock:
+                n = self._nodes.get(nid)
+                sock = n["sock"] if n and n["alive"] else None
+            if sock:
+                try:
+                    self._raylet_call(sock, "release_bundle", pg_id, i)
+                except Exception:
+                    pass
+        try:
+            self.create_placement_group(pg_id, bundles, "SLICE_GANG")
+        except Exception:
+            with self._lock:
+                pg = self._pgs.get(pg_id)
+                if pg is not None and pg.get("state") == "REPLANNING":
+                    pg["state"] = "RESCHEDULING"  # retried on next register
+
     def create_placement_group(self, pg_id: str, bundles: List[dict], strategy: str) -> dict:
         """Plans placements, then leases each bundle on its raylet — the
         raylet debits its own free pool, so the reservation is durable
         across heartbeats (reference: gcs_placement_group_scheduler.h:283
         two-phase PREPARE/COMMIT; placement_group_resource_manager.h).
         All-or-nothing: any failed lease rolls the gang back."""
+        with self._lock:
+            if pg_id in self._removed_pgs:
+                raise RuntimeError(f"placement group {pg_id[:8]} was removed")
         banned: Set[str] = set()
         last_err: Optional[str] = None
         for _ in range(4):  # replanning rounds for stale-view refusals
@@ -599,13 +722,28 @@ class GcsService:
                         except Exception:
                             pass
                 with self._lock:
-                    self._pgs[pg_id] = {
-                        "bundles": bundles,
-                        "strategy": strategy,
-                        "placements": placements,
-                        "state": "CREATED",
-                        "rr": 0,
-                    }
+                    removed = pg_id in self._removed_pgs
+                    if not removed:
+                        self._pgs[pg_id] = {
+                            "bundles": bundles,
+                            "strategy": strategy,
+                            "placements": placements,
+                            "state": "CREATED",
+                            "rr": 0,
+                        }
+                if removed:
+                    # remove_placement_group raced the (re)creation: undo
+                    # the fresh leases instead of leaking them ownerlessly.
+                    for nid, i in reserved:
+                        with self._lock:
+                            node = self._nodes.get(nid)
+                            sock = node["sock"] if node else None
+                        if sock:
+                            try:
+                                self._raylet_call(sock, "release_bundle", pg_id, i)
+                            except Exception:
+                                pass
+                    raise RuntimeError(f"placement group {pg_id[:8]} was removed")
                 return {"placements": placements}
             # Roll back partial gang, ban the refusing node, replan.
             for nid, i in reserved:
@@ -641,6 +779,11 @@ class GcsService:
     def remove_placement_group(self, pg_id: str) -> bool:
         with self._lock:
             pg = self._pgs.pop(pg_id, None)
+            # Tombstone: an in-flight gang reschedule must not resurrect a
+            # removed PG (and re-lease its bundles ownerlessly).
+            self._removed_pgs[pg_id] = True
+            while len(self._removed_pgs) > 10_000:
+                self._removed_pgs.popitem(last=False)
         if pg:
             for i, (nid, bundle) in enumerate(zip(pg["placements"], pg["bundles"])):
                 with self._lock:
@@ -665,6 +808,8 @@ class GcsService:
             pg = self._pgs.get(pg_id)
             if pg is None:
                 return None
+            if pg.get("state") not in (None, "CREATED"):
+                return None  # gang rescheduling: fail fast, no partial use
             if bundle_index < 0:
                 bundle_index = pg["rr"] % len(pg["placements"])
                 pg["rr"] += 1

@@ -59,6 +59,7 @@ class RayletService:
         gcs_sock: str,
         resources: Dict[str, float],
         store_capacity: int,
+        labels: Optional[Dict[str, Any]] = None,
     ):
         self.node_id = node_id
         self.sock_path = sock_path
@@ -68,6 +69,11 @@ class RayletService:
         self.gcs_sock = gcs_sock
         self.total = dict(resources)
         self.available = dict(resources)
+        self.labels = dict(labels or {})
+        # Physical chip indices not leased to any bundle (TPU env isolation:
+        # bundle-pinned workers see only their chips via TPU_VISIBLE_CHIPS,
+        # reference: _private/accelerators/tpu.py set_accelerator_visible).
+        self._free_chips: Set[int] = set(range(int(resources.get("TPU", 0))))
         self._res_lock = threading.Lock()
         # Placement-group bundle reservations hosted on this node:
         # (pg_id, bundle_index) -> {"reserved": {...}, "free": {...}}.
@@ -80,9 +86,10 @@ class RayletService:
         self._idle: Dict[str, List[str]] = {}  # env_key -> idle worker ids
         self._workers_lock = threading.Lock()
         self._max_task_workers = max(1, int(resources.get("CPU", 1)))
-        # Task ids cancelled before dispatch (reference: core_worker
-        # CancelTask -> raylet queued-task removal).
-        self._cancelled: Set[str] = set()
+        # Task ids with cancel intent (reference: core_worker CancelTask ->
+        # raylet queued-task removal). Bounded FIFO: broadcast cancels leave
+        # ids on raylets that never see the task.
+        self._cancelled: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
 
         self._pending: "queue.Queue" = queue.Queue()  # task entries
         # Wakes the dispatch loop on any schedulability change (new task,
@@ -141,7 +148,7 @@ class RayletService:
             threading.Thread(target=self._flush_loop, daemon=True, name="flush"),
         ]
         reg = self.gcs.call(
-            "register_node", node_id, sock_path, store_path, resources
+            "register_node", node_id, sock_path, store_path, resources, self.labels
         )
         self._cluster_size = reg.get("nodes", 1) if isinstance(reg, dict) else 1
         for t in self._threads:
@@ -225,7 +232,15 @@ class RayletService:
                 return False
             for k, v in resources.items():
                 self.available[k] = self.available.get(k, 0.0) - v
-            self._bundles[key] = {"reserved": dict(resources), "free": dict(resources)}
+            b = {"reserved": dict(resources), "free": dict(resources)}
+            n_chips = int(resources.get("TPU", 0))
+            if n_chips > 0 and len(self._free_chips) >= n_chips:
+                # Lease physical chips to the bundle: its workers get
+                # TPU_VISIBLE_CHIPS so co-located gangs never share a chip.
+                chips = sorted(self._free_chips)[:n_chips]
+                self._free_chips.difference_update(chips)
+                b["chips"] = chips
+            self._bundles[key] = b
         return True
 
     def release_bundle(self, pg_id: str, bundle_index: int) -> bool:
@@ -235,8 +250,35 @@ class RayletService:
                 return False
             for k, v in b["reserved"].items():
                 self.available[k] = min(self.total.get(k, 0.0), self.available.get(k, 0.0) + v)
+            chips = set(b.get("chips") or ())
+            self._free_chips.update(chips)
+        if chips:
+            # Workers bound to these chips must die with the lease: a new
+            # gang may be handed the same chips immediately, and two live
+            # processes must never share a chip.
+            self._retire_chip_workers(chips)
         self._sched_wake.set()
         return True
+
+    def _retire_chip_workers(self, chips: Set[int]) -> None:
+        victims: List[_Worker] = []
+        with self._workers_lock:
+            for w in self._workers.values():
+                if not w.env_key:
+                    continue
+                try:
+                    tpu = json.loads(w.env_key).get("tpu")
+                except Exception:
+                    continue
+                if tpu and chips.intersection(tpu.get("chips", ())):
+                    victims.append(w)
+        for w in victims:
+            # Kill only: the monitor loop observes the death, fails any
+            # in-flight entries, releases resources, and purges idle lists.
+            try:
+                w.proc.kill()
+            except OSError:
+                pass
 
     def _fail_if_unschedulable(self, entry: dict) -> bool:
         """Bundle-pinned work whose bundle is gone (PG removed) or whose
@@ -372,6 +414,14 @@ class RayletService:
             entry, RuntimeError(f"no node can satisfy {resources}")
         )
 
+    def _mark_cancelled(self, task_id: str) -> None:
+        self._cancelled[task_id] = True
+        while len(self._cancelled) > 10_000:
+            self._cancelled.popitem(last=False)
+
+    def is_cancelled(self, task_id: str) -> bool:
+        return task_id in self._cancelled
+
     def cancel_task(self, task_id: str, force: bool = False) -> bool:
         """Cancels a queued or running normal task (reference: core_worker
         CancelTask; queued removal + SIGINT/kill of the executor). Returns
@@ -388,14 +438,14 @@ class RayletService:
                 None,
             )
         if running is None:
-            self._cancelled.add(task_id)
+            self._mark_cancelled(task_id)
             self._sched_wake.set()
             return True
         entry = running.busy_with
         # Sticky intent: if the signalled worker dies instead of catching
         # the interrupt (e.g. SIGINT during startup imports), the monitor
         # must cancel, not retry.
-        self._cancelled.add(task_id)
+        self._mark_cancelled(task_id)
         if force:
             running.proc.kill()
             self._store_error_for(
@@ -765,7 +815,7 @@ class RayletService:
             # round trip, not one per return object).
             self._notify_sealed(sealed)
         if task_id is not None:
-            self._cancelled.discard(task_id)
+            self._cancelled.pop(task_id, None)
         with self._workers_lock:
             w = self._workers.get(worker_id)
             if w is None:
@@ -835,6 +885,17 @@ class RayletService:
             still: List[dict] = []
             for e in self._waiting:
                 try:
+                    if e.get("task_id") in self._cancelled:
+                        # Checked BEFORE deps: a cancel must take effect even
+                        # while the task waits on a never-arriving dep.
+                        self._cancelled.pop(e["task_id"], None)
+                        self._store_error_for(
+                            e,
+                            exc.TaskCancelledError(
+                                f"{e.get('desc','task')} was cancelled"
+                            ),
+                        )
+                        continue
                     if not self._deps_ready(e):
                         still.append(e)
                         continue
@@ -861,7 +922,7 @@ class RayletService:
     def _dispatch(self, entry: dict) -> bool:
         kind = entry["type"]
         if entry.get("task_id") in self._cancelled:
-            self._cancelled.discard(entry["task_id"])
+            self._cancelled.pop(entry["task_id"], None)
             self._store_error_for(
                 entry,
                 exc.TaskCancelledError(
@@ -930,12 +991,28 @@ class RayletService:
             return True
         return True
 
-    @staticmethod
-    def _env_key(entry: dict) -> str:
-        renv = entry.get("runtime_env")
-        if not renv:
+    def _env_key(self, entry: dict) -> str:
+        """Composite worker-env descriptor: runtime_env + the TPU chip
+        binding of the entry's bundle. Workers are pooled per descriptor
+        (reference: worker_pool PopWorker matching runtime_env_hash +
+        accelerator visibility)."""
+        desc: Dict[str, Any] = {}
+        if entry.get("runtime_env"):
+            desc["runtime_env"] = entry["runtime_env"]
+        key = self._entry_bundle_key(entry)
+        if key is not None:
+            with self._res_lock:
+                b = self._bundles.get(key)
+                chips = list(b.get("chips") or ()) if b else None
+            if chips:
+                desc["tpu"] = {
+                    "chips": chips,
+                    "slice": self.labels.get("slice_name", ""),
+                    "worker_index": int(self.labels.get("worker_index", 0)),
+                }
+        if not desc:
             return ""
-        return json.dumps(renv, sort_keys=True)
+        return json.dumps(desc, sort_keys=True)
 
     def _checkout_worker(self, env_key: str = "") -> Optional[_Worker]:
         with self._workers_lock:
@@ -972,14 +1049,25 @@ class RayletService:
         worker_id = uuid.uuid4().hex[:12]
         env = dict(os.environ)
         env["RAY_TPU_WORKER"] = "1"
-        if env_key and runtime_env is None:
-            runtime_env = json.loads(env_key)
+        desc = json.loads(env_key) if env_key else {}
         if runtime_env:
+            desc.setdefault("runtime_env", runtime_env)
+        renv = desc.get("runtime_env")
+        if renv:
             # Apply env_vars at spawn; working_dir is applied by the worker
             # itself (reference: runtime_env_agent building the env).
-            for k, v in (runtime_env.get("env_vars") or {}).items():
+            for k, v in (renv.get("env_vars") or {}).items():
                 env[str(k)] = str(v)
-            env["RAY_TPU_RUNTIME_ENV"] = json.dumps(runtime_env)
+            env["RAY_TPU_RUNTIME_ENV"] = json.dumps(renv)
+        tpu = desc.get("tpu")
+        if tpu:
+            # Chip isolation for co-located gangs (reference:
+            # _private/accelerators/tpu.py TPU_VISIBLE_CHIPS / worker env).
+            env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in tpu["chips"])
+            env["TPU_CHIPS_PER_HOST_BOUNDS"] = f"1,1,{len(tpu['chips'])}"
+            if tpu.get("slice"):
+                env["TPU_SLICE_NAME"] = str(tpu["slice"])
+            env["TPU_WORKER_ID"] = str(tpu.get("worker_index", 0))
         # Worker stdout/stderr land in per-process session log files
         # (reference: worker-<id>-out/err under the session's logs dir) —
         # a user print inside a task must be recoverable.
@@ -1051,7 +1139,7 @@ class RayletService:
                         self._release_entry(entry)
                     mr = entry.get("max_retries", 0)
                     if entry.get("task_id") in self._cancelled:
-                        self._cancelled.discard(entry["task_id"])
+                        self._cancelled.pop(entry["task_id"], None)
                         self._store_error_for(
                             entry,
                             exc.TaskCancelledError(
@@ -1160,8 +1248,8 @@ class RayletService:
 
 
 def main(argv: List[str]) -> None:
-    node_id, sock_path, store_path, gcs_sock, resources_json, capacity = argv
-    import json
+    node_id, sock_path, store_path, gcs_sock, resources_json, capacity = argv[:6]
+    labels = json.loads(argv[6]) if len(argv) > 6 else {}
 
     service = RayletService(
         node_id,
@@ -1170,6 +1258,7 @@ def main(argv: List[str]) -> None:
         gcs_sock,
         json.loads(resources_json),
         int(capacity),
+        labels=labels,
     )
     server = RpcServer(sock_path, service)
     try:

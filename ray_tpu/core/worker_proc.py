@@ -127,11 +127,14 @@ def main(argv: List[str]) -> None:
 
     # ----- cancellation: SIGINT interrupts the CURRENT main-thread task ---
     executing_main = threading.Event()
+    pending_interrupt = threading.Event()
 
     def _sigint(signum, frame):
         if executing_main.is_set():
             raise KeyboardInterrupt
-        # Idle / between tasks: ignore (a late cancel for a finished task).
+        # Between poll and execution: remember it — the targeted task may be
+        # the one we are about to run (verified against the raylet below).
+        pending_interrupt.set()
 
     signal.signal(signal.SIGINT, _sigint)
 
@@ -269,7 +272,7 @@ def main(argv: List[str]) -> None:
                 result = await result
             return result
 
-        def on_done(fut):
+        def finish(fut):
             sealed: List[str] = []
             try:
                 result = fut.result()
@@ -282,6 +285,11 @@ def main(argv: List[str]) -> None:
             except BaseException as e:  # noqa: BLE001
                 store_error(entry, e, sealed)
                 done(entry, False, sealed)
+
+        def on_done(fut):
+            # Completion does shm writes + a raylet RPC: run it OFF the
+            # event loop thread or concurrent coroutines stall behind it.
+            threading.Thread(target=finish, args=(fut,), daemon=True).start()
 
         aio.submit(coro, on_done)
 
@@ -330,7 +338,23 @@ def main(argv: List[str]) -> None:
             sealed = []
             executing_main.set()
             try:
+                if pending_interrupt.is_set():
+                    # A SIGINT landed before execution started: honor it
+                    # only if OUR task is the cancel target (a late signal
+                    # for an already-finished task must not kill this one).
+                    pending_interrupt.clear()
+                    if raylet.call("is_cancelled", entry["task_id"]):
+                        raise KeyboardInterrupt
                 ok = run_body(entry, sealed)
+            except KeyboardInterrupt:
+                store_error(
+                    entry,
+                    exc.TaskCancelledError(
+                        f"{entry.get('desc','task')} was cancelled"
+                    ),
+                    sealed,
+                )
+                ok = False
             except SystemExit:
                 executing_main.clear()
                 done(entry, True, sealed)

@@ -1,0 +1,137 @@
+"""SLICE_GANG: atomic TPU-slice gang scheduling with co-fail semantics.
+
+Round-3 done-criteria (reference: _private/accelerators/tpu.py:334-397
+TPU-{pod}-head idiom, bundle_scheduling_policy.h:82-106 — redesigned as a
+first-class policy): two fake 2-host slices; a 2-bundle SLICE_GANG lands
+on exactly one slice; killing one member host releases both bundles and
+restarts the gang on the other slice; workers see TPU_VISIBLE_CHIPS."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.core import runtime_base
+from ray_tpu.core.cluster_runtime import Cluster
+from ray_tpu.core.placement_group import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+)
+
+
+@pytest.fixture
+def two_slices():
+    """Head (no TPU) + two 2-host slices with 4 chips per host."""
+    rt.shutdown()
+    cluster = Cluster(num_cpus=2)
+    runtime = cluster.runtime()
+    runtime_base.set_runtime(runtime)
+    nodes = {}
+    for sl in ("slice-a", "slice-b"):
+        for widx in range(2):
+            nid = cluster.add_node(
+                num_cpus=2,
+                resources={"TPU": 4.0},
+                labels={"slice_name": sl, "worker_index": widx},
+            )
+            nodes[(sl, widx)] = nid
+    yield cluster, runtime, nodes
+    rt.shutdown()
+
+
+def _slice_of(nodes, node_id):
+    for (sl, _w), nid in nodes.items():
+        if nid == node_id:
+            return sl
+    return None
+
+
+def test_gang_lands_on_one_slice(two_slices):
+    cluster, runtime, nodes = two_slices
+    pg = placement_group(
+        [{"CPU": 1.0, "TPU": 4.0}, {"CPU": 1.0, "TPU": 4.0}], strategy="SLICE_GANG"
+    )
+    placed = [pg.bundle_placements[0], pg.bundle_placements[1]]
+    slices = {_slice_of(nodes, n) for n in placed}
+    assert len(slices) == 1 and None not in slices, f"gang split across {slices}"
+    assert len(set(placed)) == 2  # one bundle per host
+
+
+def test_gang_worker_sees_visible_chips(two_slices):
+    cluster, runtime, nodes = two_slices
+    pg = placement_group([{"CPU": 1.0, "TPU": 4.0}], strategy="SLICE_GANG")
+
+    @rt.remote(num_tpus=4, num_cpus=1)
+    def read_tpu_env():
+        return (
+            os.environ.get("TPU_VISIBLE_CHIPS"),
+            os.environ.get("TPU_SLICE_NAME"),
+            os.environ.get("TPU_WORKER_ID"),
+        )
+
+    chips, slice_name, worker_id = rt.get(
+        read_tpu_env.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=0
+            )
+        ).remote(),
+        timeout=60,
+    )
+    assert chips == "0,1,2,3"
+    assert slice_name in ("slice-a", "slice-b")
+    assert worker_id in ("0", "1")
+
+
+def test_member_death_cofails_and_reschedules(two_slices):
+    cluster, runtime, nodes = two_slices
+    pg = placement_group(
+        [{"CPU": 1.0, "TPU": 4.0}, {"CPU": 1.0, "TPU": 4.0}], strategy="SLICE_GANG"
+    )
+    first_nodes = [pg.bundle_placements[0], pg.bundle_placements[1]]
+    first_slice = _slice_of(nodes, first_nodes[0])
+    cluster.remove_node(first_nodes[0])  # kill one gang member
+
+    # The WHOLE gang must move to the other slice.
+    deadline = time.monotonic() + 20
+    table = None
+    while time.monotonic() < deadline:
+        table = runtime.placement_group_table().get(pg.id_hex)
+        if table and table["state"] == "CREATED" and set(table["placements"]) != set(first_nodes):
+            break
+        time.sleep(0.2)
+    assert table is not None and table["state"] == "CREATED"
+    new_slices = {_slice_of(nodes, n) for n in table["placements"]}
+    assert new_slices == {"slice-a", "slice-b"} - {first_slice}, (
+        f"gang did not move atomically: {table['placements']}"
+    )
+    # Sibling lease on the surviving first-slice host was released: its
+    # TPU capacity is whole again.
+    surviving = first_nodes[1]
+    avail = {n["NodeID"]: n for n in runtime.nodes()}
+    assert avail[surviving]["Alive"]
+
+    # And the rescheduled gang accepts work.
+    @rt.remote(num_cpus=1)
+    def ping():
+        return "ok"
+
+    out = rt.get(
+        ping.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=0
+            )
+        ).remote(),
+        timeout=60,
+    )
+    assert out == "ok"
+
+
+def test_gang_infeasible_without_slices():
+    rt.shutdown()
+    rt.init(num_cpus=4)  # no slice-labelled nodes at all
+    try:
+        with pytest.raises(Exception, match="slice"):
+            placement_group([{"CPU": 1.0}], strategy="SLICE_GANG")
+    finally:
+        rt.shutdown()
