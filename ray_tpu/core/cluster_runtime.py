@@ -707,10 +707,13 @@ class Cluster:
 
         self.log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(self.log_dir, exist_ok=True)
+        self.gcs_snapshot = os.path.join(self.session_dir, "gcs_state.pkl")
         gcs_proc = self._spawn_logged(
-            [sys.executable, "-m", "ray_tpu.core.gcs", self.gcs_sock], "gcs"
+            [sys.executable, "-m", "ray_tpu.core.gcs", self.gcs_sock, self.gcs_snapshot],
+            "gcs",
         )
         self._procs.append(gcs_proc)
+        self._gcs_proc = gcs_proc
         RpcClient(self.gcs_sock).call("ping")  # wait for boot
 
         head_res = dict(resources or {})
@@ -793,6 +796,20 @@ class Cluster:
         self._node_procs[node_id] = proc
         RpcClient(self._sock_for(node_id)).call("ping")
         return node_id
+
+    def restart_gcs(self) -> None:
+        """Kills and restarts the GCS daemon; state reloads from the
+        snapshot and raylets re-attach (reference: GCS fault-tolerance
+        tests around redis-backed restart)."""
+        self._gcs_proc.kill()
+        self._gcs_proc.wait(timeout=5.0)
+        self._procs.remove(self._gcs_proc)
+        self._gcs_proc = self._spawn_logged(
+            [sys.executable, "-m", "ray_tpu.core.gcs", self.gcs_sock, self.gcs_snapshot],
+            "gcs",
+        )
+        self._procs.append(self._gcs_proc)
+        RpcClient(self.gcs_sock).call("ping")
 
     def remove_node(self, node_id: str) -> None:
         """Simulated node failure (reference: cluster_utils remove_node)."""

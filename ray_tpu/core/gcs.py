@@ -31,8 +31,9 @@ TASK_TABLE_CAP = 50_000
 
 
 class GcsService:
-    def __init__(self):
+    def __init__(self, snapshot_path: Optional[str] = None):
         self._lock = threading.RLock()
+        self._snapshot_path = snapshot_path
         self._nodes: Dict[str, dict] = {}
         self._actors: Dict[str, dict] = {}
         self._named: Dict[Tuple[str, str], str] = {}
@@ -55,8 +56,76 @@ class GcsService:
         self._freed: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
         self._raylet_clients: Dict[str, Any] = {}
         self._stop = threading.Event()
+        if snapshot_path:
+            self._load_snapshot()
         self._health = threading.Thread(target=self._health_loop, daemon=True)
         self._health.start()
+
+    # ------------------------------------------------------- persistence
+    # Durable control-plane state (reference: gcs/store_client/
+    # redis_store_client.h:106 — file-backed here; a GCS restart reloads
+    # actors/PGs/KV and raylets re-register via heartbeat NACK, the
+    # RayletNotifyGCSRestart analogue, core_worker.proto:441).
+    _PERSISTED = (
+        "_nodes",
+        "_actors",
+        "_named",
+        "_pgs",
+        "_kv",
+        "_objects",
+        "_freed",
+        "_borrows",
+        "_deferred_free",
+    )
+
+    def _load_snapshot(self) -> None:
+        import pickle
+
+        try:
+            with open(self._snapshot_path, "rb") as f:
+                data = pickle.load(f)
+        except (OSError, EOFError, pickle.UnpicklingError):
+            return
+        with self._lock:
+            for name in self._PERSISTED:
+                if name in data:
+                    setattr(self, name, data[name])
+            now = time.monotonic()
+            for n in self._nodes.values():
+                # Grace: loaded nodes get a fresh heartbeat window; truly
+                # dead ones expire through the normal health check.
+                n["last_hb"] = now
+            for pg in self._pgs.values():
+                # A snapshot taken mid-reschedule must resume as
+                # RESCHEDULING: only that state is retried.
+                if pg.get("state") == "REPLANNING":
+                    pg["state"] = "RESCHEDULING"
+
+    def _save_snapshot(self) -> None:
+        if not self._snapshot_path:
+            return
+        import copy
+        import pickle
+
+        with self._lock:
+            # Shallow-ish copies under the lock (fast pointer copies);
+            # the expensive pickle runs OUTSIDE so RPCs aren't stalled.
+            data = {
+                name: copy.copy(getattr(self, name)) for name in self._PERSISTED
+            }
+        try:
+            blob = pickle.dumps(data)
+        except Exception:
+            return
+        tmp = self._snapshot_path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            import os
+
+            os.replace(tmp, self._snapshot_path)
+        except OSError:
+            pass  # retried next interval
 
     # ------------------------------------------------------------- nodes
     def register_node(
@@ -91,13 +160,15 @@ class GcsService:
             ).start()
         return {"ok": True, "nodes": n_alive}
 
-    def heartbeat(self, node_id: str, available: dict) -> dict:
+    def heartbeat(self, node_id: str, available: dict, stats: Optional[dict] = None) -> dict:
         with self._lock:
             n = self._nodes.get(node_id)
             alive = sum(1 for m in self._nodes.values() if m["alive"])
             if n is None:
                 return {"ok": False, "nodes": alive}
             n["available"] = dict(available)
+            if stats:
+                n["stats"] = dict(stats)
             n["last_hb"] = time.monotonic()
             if not n["alive"]:
                 n["alive"] = True
@@ -116,9 +187,73 @@ class GcsService:
         with self._lock:
             return [
                 {"NodeID": nid, "Alive": n["alive"], "Resources": dict(n["resources"]),
+                 "Available": dict(n["available"]), "Labels": dict(n.get("labels") or {}),
+                 "Stats": dict(n.get("stats") or {}),
                  "sock": n["sock"], "store": n["store"]}
                 for nid, n in self._nodes.items()
             ]
+
+    def list_actors(self, limit: int = 1000) -> List[dict]:
+        """Actor table summary for the state API (reference:
+        python/ray/util/state/api.py list_actors)."""
+        with self._lock:
+            out = [
+                {
+                    "actor_id": aid,
+                    "state": a["state"],
+                    "node_id": a.get("node_id"),
+                    "name": a.get("name"),
+                    "namespace": a.get("namespace"),
+                    "num_restarts": a.get("num_restarts", 0),
+                    "max_restarts": a.get("max_restarts", 0),
+                    "pg_id": a.get("pg_id"),
+                    "death_reason": a.get("death_reason", ""),
+                }
+                for aid, a in self._actors.items()
+            ]
+        return out[-limit:]
+
+    def list_objects(self, limit: int = 1000) -> List[dict]:
+        """Object directory summary (reference: list_objects in the state
+        API; ours reports locations + borrow/pending-free status)."""
+        with self._lock:
+            out = []
+            for h, locs in list(self._objects.items())[-limit:]:
+                out.append(
+                    {
+                        "object_id": h,
+                        "locations": sorted(locs),
+                        "borrows": self._borrows.get(h, 0),
+                        "pending_free": h in self._deferred_free,
+                    }
+                )
+        return out
+
+    def stats(self) -> dict:
+        """Cluster-wide counters (reference: src/ray/stats/metric.h — the
+        aggregate half; per-node gauges ride heartbeats)."""
+        with self._lock:
+            by_state: Dict[str, int] = {}
+            for rec in self._tasks.values():
+                by_state[rec["state"]] = by_state.get(rec["state"], 0) + 1
+            actor_states: Dict[str, int] = {}
+            for a in self._actors.values():
+                actor_states[a["state"]] = actor_states.get(a["state"], 0) + 1
+            store = {"bytes_in_use": 0, "num_objects": 0, "num_spilled": 0}
+            for n in self._nodes.values():
+                if not n["alive"]:
+                    continue
+                s = n.get("stats") or {}
+                for k in store:
+                    store[k] += int(s.get(k, 0))
+            return {
+                "tasks": by_state,
+                "actors": actor_states,
+                "objects_indexed": len(self._objects),
+                "store": store,
+                "nodes_alive": sum(1 for n in self._nodes.values() if n["alive"]),
+                "placement_groups": len(self._pgs),
+            }
 
     def node_info(self, node_id: str) -> Optional[dict]:
         with self._lock:
@@ -168,9 +303,12 @@ class GcsService:
 
     def _health_loop(self):
         tick = 0
+        snap_every = max(1, int(CONFIG.gcs_snapshot_interval_s / 0.1))
         while not self._stop.wait(0.1):
             self._process_frees()
             tick += 1
+            if tick % snap_every == 0:
+                self._save_snapshot()
             if tick % 20 == 0:
                 # Stranded gangs retry when capacity frees up, not only on
                 # node registration.
@@ -844,10 +982,10 @@ class GcsService:
         return True
 
 
-def main(sock_path: str) -> None:
+def main(sock_path: str, snapshot_path: Optional[str] = None) -> None:
     from .rpc import RpcServer
 
-    service = GcsService()
+    service = GcsService(snapshot_path=snapshot_path or sock_path + ".snapshot")
     server = RpcServer(sock_path, service)
     try:
         while not service._stop.wait(0.5):
@@ -857,4 +995,4 @@ def main(sock_path: str) -> None:
 
 
 if __name__ == "__main__":
-    main(sys.argv[1])
+    main(sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else None)

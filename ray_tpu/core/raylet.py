@@ -1220,10 +1220,32 @@ class RayletService:
         while not self._stop.wait(CONFIG.heartbeat_interval_s):
             with self._res_lock:
                 avail = dict(self.available)
+            with self._workers_lock:
+                n_workers = len(self._workers)
+            with self._spill_lock:
+                n_spilled = len(self._spilled)
+            stats = {
+                "bytes_in_use": self.store.bytes_in_use(),
+                "num_objects": self.store.num_objects(),
+                "num_spilled": n_spilled,
+                "num_workers": n_workers,
+            }
             try:
-                reply = self.gcs.call("heartbeat", self.node_id, avail)
+                reply = self.gcs.call("heartbeat", self.node_id, avail, stats)
                 if isinstance(reply, dict):
                     self._cluster_size = reply.get("nodes", self._cluster_size)
+                    if not reply.get("ok", True):
+                        # The GCS restarted without our registration (lost
+                        # or stale snapshot): re-register (reference:
+                        # RayletNotifyGCSRestart, core_worker.proto:441).
+                        self.gcs.call(
+                            "register_node",
+                            self.node_id,
+                            self.sock_path,
+                            self.store_path,
+                            self.total,
+                            self.labels,
+                        )
             except Exception:
                 pass
 

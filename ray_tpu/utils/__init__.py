@@ -1,6 +1,7 @@
 """Shared utilities: the runtime flag system and (per-subsystem) helpers
 (reference: src/ray/util/ + src/ray/common/ray_config.h)."""
 
+from . import state
 from .config import CONFIG, RayTpuConfig, all_flags
 
-__all__ = ["CONFIG", "RayTpuConfig", "all_flags"]
+__all__ = ["CONFIG", "RayTpuConfig", "all_flags", "state"]

@@ -1,0 +1,91 @@
+"""Cluster state API: live views of nodes, actors, tasks, and objects.
+
+The TPU-native analogue of the reference's state API (reference:
+python/ray/util/state/api.py list_nodes/list_actors/list_tasks/
+list_objects + summarize_*). Queries go to the GCS tables that the
+raylets feed via batched events and heartbeats — no extra agents.
+
+    from ray_tpu.utils import state
+    state.list_tasks()          # task table with states + retry counts
+    state.list_actors()         # incl. num_restarts
+    state.cluster_stats()       # aggregate counters
+    state.log_dir()             # per-process session logs
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from ..core import runtime_base
+
+
+def _gcs():
+    rt = runtime_base.current_runtime()
+    gcs = getattr(rt, "_gcs", None)
+    if gcs is None:
+        raise RuntimeError("the state API requires cluster mode (ray_tpu.init())")
+    return gcs
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    """Nodes with liveness, resources, labels, and store gauges."""
+    return _gcs().call("list_nodes")
+
+
+def list_actors(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Actor table: state, placement, restart counts, death reasons."""
+    return _gcs().call("list_actors", limit)
+
+
+def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Recent task states (QUEUED/RUNNING/FINISHED/FAILED + retries)."""
+    return _gcs().call("list_tasks", limit)
+
+
+def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Object directory: locations, borrows, pending frees."""
+    return _gcs().call("list_objects", limit)
+
+
+def list_placement_groups() -> Dict[str, Dict[str, Any]]:
+    return _gcs().call("placement_group_table")
+
+
+def cluster_stats() -> Dict[str, Any]:
+    """Aggregate counters: tasks by state, actors by state, store usage."""
+    return _gcs().call("stats")
+
+
+def get_task(task_id: str) -> Optional[Dict[str, Any]]:
+    return _gcs().call("get_task_states", [task_id]).get(task_id)
+
+
+def log_dir() -> Optional[str]:
+    """The session's log directory (gcs/raylet/worker stdout+stderr)."""
+    rt = runtime_base.current_runtime()
+    session = getattr(rt, "_session_dir", None)
+    if session is None:
+        # Worker-side: derive from the raylet socket's directory.
+        raylet = getattr(rt, "_raylet", None)
+        if raylet is None:
+            return None
+        session = os.path.dirname(raylet.path)
+    return os.path.join(session, "logs")
+
+
+def read_worker_logs() -> Dict[str, str]:
+    """All captured worker output, keyed by log file name."""
+    d = log_dir()
+    out: Dict[str, str] = {}
+    if d and os.path.isdir(d):
+        for fname in sorted(os.listdir(d)):
+            if fname.startswith("worker_"):
+                try:
+                    with open(os.path.join(d, fname)) as f:
+                        data = f.read()
+                except OSError:
+                    continue
+                if data:
+                    out[fname] = data
+    return out

@@ -1,0 +1,73 @@
+"""GCS persistence + restart: kill and restart the control plane; actors
+remain callable and named actors stay resolvable.
+
+Round-3 done-criterion (reference: gcs/store_client/redis_store_client.h
+file-backed here; RayletNotifyGCSRestart analogue = heartbeat NACK ->
+re-register)."""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.core import runtime_base
+from ray_tpu.core.cluster_runtime import Cluster
+
+
+@pytest.fixture
+def cluster():
+    rt.shutdown()
+    c = Cluster(num_cpus=4)
+    runtime = c.runtime()
+    runtime_base.set_runtime(runtime)
+    yield c, runtime
+    rt.shutdown()
+
+
+def test_gcs_restart_preserves_actors_and_kv(cluster):
+    c, runtime = cluster
+
+    @rt.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    a = Counter.options(name="survivor").remote()
+    assert rt.get(a.incr.remote(), timeout=60) == 1
+    runtime._gcs.call("kv_put", "mykey", b"myvalue")
+    time.sleep(1.5)  # let the snapshot interval capture the state
+
+    c.restart_gcs()
+
+    # Existing handle still works (actor process never died).
+    assert rt.get(a.incr.remote(), timeout=60) == 2
+    # Named actor resolvable from the reloaded table.
+    b = rt.get_actor("survivor")
+    assert rt.get(b.incr.remote(), timeout=60) == 3
+    # KV survived.
+    assert runtime._gcs.call("kv_get", "mykey") == b"myvalue"
+
+
+def test_gcs_restart_tasks_still_flow(cluster):
+    c, runtime = cluster
+
+    @rt.remote
+    def f(x):
+        return x * 2
+
+    assert rt.get(f.remote(4), timeout=60) == 8
+    time.sleep(1.2)
+    c.restart_gcs()
+    # New tasks schedule fine; raylets re-registered via heartbeat NACK.
+    assert rt.get(f.remote(5), timeout=60) == 10
+    # And cross-checking the node table repopulated.
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if any(n["Alive"] for n in runtime.nodes()):
+            break
+        time.sleep(0.3)
+    assert any(n["Alive"] for n in runtime.nodes())
