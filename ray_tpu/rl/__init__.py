@@ -3,22 +3,29 @@ API stack, SURVEY.md §2g): RLModule (jax), EnvRunner (gymnasium),
 JaxLearner (jitted optax update, in-program psum instead of NCCL DDP),
 PPO and IMPALA."""
 
+from .dqn import DQN, DQNConfig, QModule, dqn_loss
 from .env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 from .impala import IMPALA, IMPALAConfig, impala_loss, vtrace
 from .learner import JaxLearner, LearnerGroup
 from .module import (
     DiscretePolicyConfig,
     DiscretePolicyModule,
+    GaussianPolicyConfig,
+    GaussianPolicyModule,
     RLModule,
+    build_module_for_env,
     logp_entropy,
     sample_actions,
 )
 from .ppo import PPO, PPOConfig, compute_gae, ppo_loss
+from .replay import TransitionReplayBuffer
 
 __all__ = [
     "EnvRunnerGroup", "SingleAgentEnvRunner", "IMPALA", "IMPALAConfig",
     "impala_loss", "vtrace", "JaxLearner", "LearnerGroup",
     "DiscretePolicyConfig", "DiscretePolicyModule", "RLModule",
+    "GaussianPolicyConfig", "GaussianPolicyModule", "build_module_for_env",
     "logp_entropy", "sample_actions", "PPO", "PPOConfig", "compute_gae",
-    "ppo_loss",
+    "ppo_loss", "DQN", "DQNConfig", "QModule", "dqn_loss",
+    "TransitionReplayBuffer",
 ]

@@ -1,0 +1,241 @@
+"""DQN: off-policy Q-learning over the replay buffer.
+
+Re-design of the reference's DQN (reference: rllib/algorithms/dqn/dqn.py
+training_step — sample -> store -> replay -> learner update -> target-net
+sync; loss rllib/algorithms/dqn/torch/dqn_torch_learner.py). The Q
+network, Huber TD loss, and target computation are jitted jax; the target
+network is a frozen param copy refreshed every `target_update_freq`
+updates; epsilon-greedy exploration rides the synced param pytree so env
+runners decay epsilon with every weight broadcast.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .env_runner import EnvRunnerGroup
+from .learner import LearnerGroup
+from .module import DiscretePolicyConfig, DiscretePolicyModule, RLModule
+from .replay import TransitionReplayBuffer
+
+
+class QModule(RLModule):
+    """MLP Q-network with epsilon-greedy exploration carried in params."""
+
+    action_kind = "discrete"
+
+    def __init__(self, config: DiscretePolicyConfig):
+        self.config = config
+        self._helper = DiscretePolicyModule(config)
+
+    def init_params(self, key: jax.Array):
+        c = self.config
+        return {
+            "q": self._helper._mlp_params(key, (c.obs_dim,) + c.hidden + (c.n_actions,)),
+            "epsilon": jnp.asarray(1.0, jnp.float32),
+        }
+
+    def forward_inference(self, params, obs):
+        q = DiscretePolicyModule._mlp(params["q"], obs)
+        return {"q": q}
+
+    def sample_with_params(self, params, key, fwd_out):
+        q = fwd_out["q"]
+        kd, ke = jax.random.split(key)
+        greedy = jnp.argmax(q, axis=-1)
+        random_a = jax.random.randint(kd, greedy.shape, 0, q.shape[-1])
+        explore = jax.random.uniform(ke, greedy.shape) < params["epsilon"]
+        action = jnp.where(explore, random_a, greedy)
+        return action, jnp.zeros_like(q[..., 0])  # logp unused off-policy
+
+
+def dqn_loss(module: RLModule, params, batch):
+    """Huber TD error against precomputed targets (reference:
+    dqn_torch_learner.py compute_loss_for_module; targets are produced
+    outside the learner from the frozen target net)."""
+    q = module.forward_train(params, batch["obs"])["q"]
+    q_taken = jnp.take_along_axis(q, batch["actions"][..., None], axis=-1)[..., 0]
+    td = q_taken - batch["targets"]
+    huber = jnp.where(jnp.abs(td) <= 1.0, 0.5 * td**2, jnp.abs(td) - 0.5)
+    loss = jnp.mean(huber)
+    return loss, {"td_error_mean": jnp.mean(jnp.abs(td)), "q_mean": jnp.mean(q_taken)}
+
+
+@dataclasses.dataclass
+class DQNConfig:
+    """(reference: dqn.py DQNConfig)"""
+
+    env: str = "CartPole-v1"
+    num_env_runners: int = 1
+    num_envs_per_runner: int = 8
+    rollout_length: int = 16
+    buffer_capacity: int = 50_000
+    learning_starts: int = 500
+    train_batch_size: int = 64
+    updates_per_iteration: int = 16
+    gamma: float = 0.99
+    lr: float = 5e-4
+    grad_clip: Optional[float] = 10.0
+    target_update_freq: int = 200      # learner updates between target syncs
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_steps: int = 5_000   # env steps to reach epsilon_final
+    double_q: bool = True
+    hidden: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+
+    def environment(self, env: str) -> "DQNConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int, num_envs_per_runner: int = 8) -> "DQNConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_runner
+        return self
+
+    def training(self, **kw) -> "DQNConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise AttributeError(k)
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    """(reference: Algorithm + DQN.training_step)"""
+
+    def __init__(self, config: DQNConfig):
+        import gymnasium as gym
+
+        self.config = config
+        probe = gym.make(config.env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        n_actions = int(probe.action_space.n)
+        probe.close()
+        self.module = QModule(
+            DiscretePolicyConfig(obs_dim=obs_dim, n_actions=n_actions, hidden=tuple(config.hidden))
+        )
+        self.learner_group = LearnerGroup(
+            self.module, dqn_loss, num_learners=1, lr=config.lr,
+            grad_clip=config.grad_clip, seed=config.seed,
+        )
+        self.env_runner_group = EnvRunnerGroup(
+            config.env, self.module,
+            num_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_runner,
+            seed=config.seed,
+        )
+        self.buffer = TransitionReplayBuffer(config.buffer_capacity, seed=config.seed)
+        self.target_params = jax.device_get(self.learner_group.get_weights())
+        self._targets = jax.jit(self._compute_targets)
+        self.num_env_steps = 0
+        self.num_updates = 0
+        self.iteration = 0
+        self._sync_epsilon()
+
+    # -------------------------------------------------------------- misc
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self.num_env_steps / max(1, c.epsilon_decay_steps))
+        return float(c.epsilon_initial + frac * (c.epsilon_final - c.epsilon_initial))
+
+    def _sync_epsilon(self) -> None:
+        params = self.learner_group.get_weights()
+        params = dict(params)
+        params["epsilon"] = np.asarray(self._epsilon(), np.float32)
+        self.learner_group.set_weights(params)
+        self.env_runner_group.sync_weights(params)
+
+    def _compute_targets(self, target_params, online_params, batch):
+        c = self.config
+        q_next_t = self.module.forward_inference(target_params, batch["next_obs"])["q"]
+        if c.double_q:
+            # Double-Q: online net selects, target net evaluates
+            # (reference: dqn_torch_learner double_q branch).
+            q_next_o = self.module.forward_inference(online_params, batch["next_obs"])["q"]
+            best = jnp.argmax(q_next_o, axis=-1)
+            q_next = jnp.take_along_axis(q_next_t, best[..., None], axis=-1)[..., 0]
+        else:
+            q_next = jnp.max(q_next_t, axis=-1)
+        return batch["rewards"] + c.gamma * (1.0 - batch["terminateds"]) * q_next
+
+    # -------------------------------------------------------------- train
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        rollouts = self.env_runner_group.sample(cfg.rollout_length)
+        for ro in rollouts:
+            self.num_env_steps += self.buffer.add_rollout(ro)
+
+        metrics: Dict[str, float] = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            accum = []
+            # One weight fetch per iteration for double-Q action selection:
+            # per-update fetches would ship the full pytree each step, and
+            # <= updates_per_iteration staleness in the SELECTION net is
+            # benign (the target net is far staler by design).
+            online = self.learner_group.get_weights()
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                batch["targets"] = np.asarray(
+                    self._targets(self.target_params, online, batch)
+                )
+                accum.append(self.learner_group.update(batch))
+                self.num_updates += 1
+                if self.num_updates % cfg.target_update_freq == 0:
+                    self.target_params = jax.device_get(self.learner_group.get_weights())
+            metrics = {
+                k: float(np.mean([m[k] for m in accum])) for k in accum[0]
+            }
+
+        self._sync_epsilon()
+        self.iteration += 1
+        returns = self.env_runner_group.episode_returns()
+        return {
+            "iteration": self.iteration,
+            "num_env_steps_sampled": self.num_env_steps,
+            "num_updates": self.num_updates,
+            "epsilon": self._epsilon(),
+            "buffer_size": len(self.buffer),
+            "episode_return_mean": float(np.mean(returns)) if returns else float("nan"),
+            "num_episodes": len(returns),
+            **metrics,
+        }
+
+    # --------------------------------------------------------- checkpoint
+    def save(self, directory: str) -> None:
+        from ..train.checkpoint import save_pytree
+
+        save_pytree(
+            {
+                "params": self.learner_group.get_weights(),
+                "target": self.target_params,
+                "counters": {
+                    "num_env_steps": self.num_env_steps,
+                    "num_updates": self.num_updates,
+                    "iteration": self.iteration,
+                },
+            },
+            directory,
+        )
+
+    def restore(self, directory: str) -> None:
+        from ..train.checkpoint import load_pytree
+
+        data = load_pytree(directory)
+        self.learner_group.set_weights(data["params"])
+        self.target_params = data["target"]
+        counters = data.get("counters", {})
+        # Counters drive epsilon decay + target cadence: without them a
+        # restored near-greedy policy would revert to fully random.
+        self.num_env_steps = int(counters.get("num_env_steps", 0))
+        self.num_updates = int(counters.get("num_updates", 0))
+        self.iteration = int(counters.get("iteration", 0))
+        self._sync_epsilon()

@@ -16,7 +16,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .. import api
-from .module import RLModule, sample_actions
+from .module import RLModule
 
 
 class SingleAgentEnvRunner:
@@ -42,6 +42,10 @@ class SingleAgentEnvRunner:
         self._prev_done = np.zeros(num_envs, np.float32)
 
         self._infer = jax.jit(self.module.forward_exploration)
+        # The distribution lives on the module (discrete categorical,
+        # continuous Gaussian, epsilon-greedy Q): jitted with params so
+        # exploration state (e.g. epsilon) can ride the weight sync.
+        self._sample = jax.jit(lambda params, key, out: self.module.sample_with_params(params, key, out))
 
     @staticmethod
     def _flatten(obs: np.ndarray) -> np.ndarray:
@@ -60,7 +64,10 @@ class SingleAgentEnvRunner:
         assert self._params is not None, "set_weights before sample"
         T, N = num_steps, self.num_envs
         obs_buf = np.zeros((T, N) + self._obs.shape[1:], np.float32)
-        act_buf = np.zeros((T, N), np.int64)
+        if self.module.action_kind == "continuous":
+            act_buf = np.zeros((T, N) + tuple(self.module.action_shape), np.float32)
+        else:
+            act_buf = np.zeros((T, N), np.int64)
         logp_buf = np.zeros((T, N), np.float32)
         val_buf = np.zeros((T, N), np.float32)
         rew_buf = np.zeros((T, N), np.float32)
@@ -73,14 +80,18 @@ class SingleAgentEnvRunner:
         for t in range(T):
             out = self._infer(self._params, obs)
             self._key, sub = jax.random.split(self._key)
-            action, logp = sample_actions(sub, out["logits"])
+            action, logp = self._sample(self._params, sub, out)
             action = np.asarray(action)
             obs_buf[t] = obs
             act_buf[t] = action
             logp_buf[t] = np.asarray(logp)
-            val_buf[t] = np.asarray(out["vf"])
+            if "vf" in out:  # value-less modules (e.g. DQN's Q net)
+                val_buf[t] = np.asarray(out["vf"])
             mask_buf[t] = 1.0 - self._prev_done
-            obs, rew, terminated, truncated, _ = self.envs.step(action)
+            # Bounds apply only at the env interface; the buffer keeps the
+            # unclipped action so (action, logp) stay consistent.
+            env_action = np.asarray(self.module.clip_action(action))
+            obs, rew, terminated, truncated, _ = self.envs.step(env_action)
             obs = self._flatten(obs)
             done = np.logical_or(terminated, truncated)
             rew_buf[t] = rew
@@ -101,7 +112,12 @@ class SingleAgentEnvRunner:
         # the padding row's value IS V(final_obs) — advantage estimators
         # bootstrap through truncation ((1-terminated) on the delta) while
         # the recursion still cuts at any episode boundary ((1-done)).
-        last_val = np.asarray(self._infer(self._params, obs)["vf"])
+        last_out = self._infer(self._params, obs)
+        last_val = (
+            np.asarray(last_out["vf"])
+            if "vf" in last_out
+            else np.zeros((N,), np.float32)
+        )
         return {
             "obs": obs_buf,
             "actions": act_buf,

@@ -23,7 +23,14 @@ PyTree = Any
 class RLModule:
     """ABC. forward_* mirror the reference's inference/exploration/train
     forwards (rl_module.py: forward_inference/forward_exploration/
-    forward_train)."""
+    forward_train). The action distribution lives ON the module (sample /
+    logp_entropy), the analogue of the reference's per-module action-dist
+    classes (rllib/models/distributions.py + catalog wiring), so env
+    runners and losses are action-space agnostic."""
+
+    # ("discrete", ()) or ("continuous", (act_dim,)) — buffers + env glue.
+    action_kind: str = "discrete"
+    action_shape: Tuple[int, ...] = ()
 
     def init_params(self, key: jax.Array) -> PyTree:
         raise NotImplementedError
@@ -36,6 +43,24 @@ class RLModule:
 
     def forward_train(self, params: PyTree, obs: jax.Array) -> Dict[str, jax.Array]:
         return self.forward_inference(params, obs)
+
+    # ---- action distribution ----
+    def sample(self, key: jax.Array, fwd_out: Dict[str, jax.Array]):
+        """(action, logp) from the exploration forward output."""
+        return sample_actions(key, fwd_out["logits"])
+
+    def logp_entropy(self, fwd_out: Dict[str, jax.Array], actions: jax.Array):
+        return logp_entropy(fwd_out["logits"], actions)
+
+    def sample_with_params(self, params: PyTree, key: jax.Array, fwd_out):
+        """Sampling hook that can read exploration state carried in the
+        param pytree (e.g. a synced epsilon); default ignores params."""
+        return self.sample(key, fwd_out)
+
+    def clip_action(self, action):
+        """Maps a stored action to what the env receives (identity unless
+        the module has bounds)."""
+        return action
 
 
 @dataclasses.dataclass(frozen=True)
@@ -89,6 +114,77 @@ class DiscretePolicyModule(RLModule):
         return {"logits": logits, "vf": value}
 
 
+@dataclasses.dataclass(frozen=True)
+class GaussianPolicyConfig:
+    obs_dim: int
+    act_dim: int
+    hidden: Tuple[int, ...] = (64, 64)
+    # Per-dimension bounds (scalars broadcast).
+    low: Any = -1.0
+    high: Any = 1.0
+    init_log_std: float = 0.0
+    dtype: Any = jnp.float32
+
+
+class GaussianPolicyModule(RLModule):
+    """Diagonal-Gaussian policy for continuous (Box) action spaces with a
+    state-independent learned log-std (the reference's default for
+    continuous PPO: free_log_std MLP head, rllib catalog)."""
+
+    action_kind = "continuous"
+
+    def __init__(self, config: GaussianPolicyConfig):
+        self.config = config
+        self.action_shape = (config.act_dim,)
+
+    def init_params(self, key: jax.Array) -> PyTree:
+        c = self.config
+        km, kv = jax.random.split(key)
+        helper = DiscretePolicyModule(
+            DiscretePolicyConfig(obs_dim=c.obs_dim, n_actions=c.act_dim, hidden=c.hidden)
+        )
+        return {
+            "mean": helper._mlp_params(km, (c.obs_dim,) + c.hidden + (c.act_dim,)),
+            "log_std": jnp.full((c.act_dim,), c.init_log_std, c.dtype),
+            "vf": helper._mlp_params(kv, (c.obs_dim,) + c.hidden + (1,)),
+        }
+
+    def forward_inference(self, params, obs):
+        mean = DiscretePolicyModule._mlp(params["mean"], obs)
+        value = DiscretePolicyModule._mlp(params["vf"], obs)[..., 0]
+        return {"mean": mean, "log_std": params["log_std"], "vf": value}
+
+    def sample(self, key, fwd_out):
+        mean, log_std = fwd_out["mean"], fwd_out["log_std"]
+        std = jnp.exp(log_std)
+        noise = jax.random.normal(key, mean.shape, mean.dtype)
+        action = mean + std * noise
+        logp = self._normal_logp(action, mean, log_std)
+        # The UNCLIPPED action is returned/stored so (action, logp) stay
+        # consistent; bounds are applied only at the env interface via
+        # clip_action (the reference's clip-not-squash behavior).
+        return action, logp
+
+    def clip_action(self, action):
+        c = self.config
+        return jnp.clip(action, jnp.asarray(c.low), jnp.asarray(c.high))
+
+    def logp_entropy(self, fwd_out, actions):
+        mean, log_std = fwd_out["mean"], fwd_out["log_std"]
+        logp = self._normal_logp(actions, mean, log_std)
+        entropy = jnp.sum(log_std + 0.5 * math.log(2 * math.pi * math.e), axis=-1)
+        entropy = jnp.broadcast_to(entropy, logp.shape)
+        return logp, entropy
+
+    @staticmethod
+    def _normal_logp(x, mean, log_std):
+        var = jnp.exp(2 * log_std)
+        return jnp.sum(
+            -0.5 * ((x - mean) ** 2 / var) - log_std - 0.5 * math.log(2 * math.pi),
+            axis=-1,
+        )
+
+
 def sample_actions(key: jax.Array, logits: jax.Array):
     """Categorical sample + logp (exploration path)."""
     action = jax.random.categorical(key, logits)
@@ -125,3 +221,33 @@ def build_discrete_module(env_name: str, hidden: Tuple[int, ...]) -> DiscretePol
     return DiscretePolicyModule(
         DiscretePolicyConfig(obs_dim=obs_dim, n_actions=n_actions, hidden=tuple(hidden))
     )
+
+
+def build_module_for_env(env_name: str, hidden: Tuple[int, ...]) -> RLModule:
+    """Default module for an env: categorical for Discrete action spaces,
+    diagonal Gaussian for Box (reference: rllib catalog dispatch on the
+    action space)."""
+    import gymnasium as gym
+    import numpy as np
+
+    probe = gym.make(env_name)
+    obs_dim = int(np.prod(probe.observation_space.shape))
+    space = probe.action_space
+    try:
+        if hasattr(space, "n"):
+            return DiscretePolicyModule(
+                DiscretePolicyConfig(
+                    obs_dim=obs_dim, n_actions=int(space.n), hidden=tuple(hidden)
+                )
+            )
+        return GaussianPolicyModule(
+            GaussianPolicyConfig(
+                obs_dim=obs_dim,
+                act_dim=int(np.prod(space.shape)),
+                hidden=tuple(hidden),
+                low=tuple(float(x) for x in np.asarray(space.low).ravel()),
+                high=tuple(float(x) for x in np.asarray(space.high).ravel()),
+            )
+        )
+    finally:
+        probe.close()

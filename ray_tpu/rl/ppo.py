@@ -17,7 +17,7 @@ import numpy as np
 
 from .env_runner import EnvRunnerGroup
 from .learner import LearnerGroup
-from .module import RLModule, build_discrete_module, logp_entropy, masked_mean
+from .module import RLModule, build_module_for_env, masked_mean
 
 
 @dataclasses.dataclass
@@ -95,7 +95,7 @@ def ppo_loss(module: RLModule, params, batch, *, clip: float, vf_coeff: float, e
     ppo_torch_learner.py compute_loss_for_module). Autoreset padding steps
     carry mask=0 and contribute nothing."""
     out = module.forward_train(params, batch["obs"])
-    logp, entropy = logp_entropy(out["logits"], batch["actions"])
+    logp, entropy = module.logp_entropy(out, batch["actions"])
     mask = batch.get("mask")
     ratio = jnp.exp(logp - batch["logp"])
     adv = batch["advantages"]
@@ -119,7 +119,7 @@ class PPO:
         import functools
 
         self.config = config
-        self.module = build_discrete_module(config.env, config.hidden)
+        self.module = build_module_for_env(config.env, config.hidden)
         loss = functools.partial(
             ppo_loss,
             clip=config.clip_param,
@@ -160,9 +160,11 @@ class PPO:
                 ro["rewards"], ro["values"], ro["dones"], ro["last_values"],
                 cfg.gamma, cfg.gae_lambda, terminateds=ro["terminateds"],
             )
+            # Actions keep their trailing action dims (continuous modules).
+            act_shape = tuple(getattr(self.module, "action_shape", ()) or ())
             flat = {
                 "obs": ro["obs"].reshape(-1, ro["obs"].shape[-1]),
-                "actions": ro["actions"].reshape(-1),
+                "actions": ro["actions"].reshape((-1,) + act_shape),
                 "logp": ro["logp"].reshape(-1),
                 "advantages": adv.reshape(-1),
                 "returns": ret.reshape(-1),
