@@ -25,6 +25,8 @@ class Replica:
     """Replica actor body wrapping the user callable (reference:
     serve/_private/replica.py:828 UserCallableWrapper)."""
 
+    STREAM_MARKER = "__ray_tpu_stream__"
+
     def __init__(self, cls_blob: bytes, init_args, init_kwargs):
         import cloudpickle
 
@@ -36,14 +38,24 @@ class Replica:
         self._ongoing = 0
         self._lock = threading.Lock()
         self._total = 0
+        # Streaming responses: generator outputs run in a background thread
+        # into a bounded queue, pulled chunk-wise by the caller (reference:
+        # replica.py handle_request_streaming over the streaming generator
+        # protocol — here a pull protocol over actor RPCs, which gives the
+        # same incremental delivery + backpressure without a new channel
+        # primitive).
+        self._streams: Dict[str, Any] = {}
 
     def handle_request(self, method: str, args, kwargs):
         import asyncio
         import inspect
+        import queue as _queue
+        import uuid
 
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        streaming = False
         try:
             fn = self._callable if method == "__call__" else getattr(self._callable, method)
             if method == "__call__" and not callable(self._callable):
@@ -51,10 +63,91 @@ class Replica:
             out = fn(*args, **kwargs)
             if inspect.iscoroutine(out):
                 out = asyncio.run(out)
+            if inspect.isgenerator(out) or inspect.isasyncgen(out):
+                # Register a stream instead of materializing it. The
+                # request stays in the _ongoing count until the stream
+                # finishes (load accounting/autoscaling must see active
+                # streams); the pump gives up if the consumer disappears.
+                stream_id = uuid.uuid4().hex
+                q: "_queue.Queue" = _queue.Queue(maxsize=16)  # backpressure
+                finished = threading.Event()
+
+                def finish_stream():
+                    if finished.is_set():
+                        return
+                    finished.set()
+                    with self._lock:
+                        self._ongoing -= 1
+                    self._streams.pop(stream_id, None)
+
+                def put_or_abandon(item) -> bool:
+                    try:
+                        # No pull for this long = consumer gone (client
+                        # disconnect / dropped generator): abandon.
+                        q.put(item, timeout=60.0)
+                        return True
+                    except _queue.Full:
+                        finish_stream()
+                        return False
+
+                def pump(gen=out):
+                    try:
+                        if inspect.isasyncgen(gen):
+                            async def drain():
+                                async for chunk in gen:
+                                    if not put_or_abandon(("chunk", chunk)):
+                                        return False
+                                return True
+
+                            if not asyncio.run(drain()):
+                                return
+                        else:
+                            for chunk in gen:
+                                if not put_or_abandon(("chunk", chunk)):
+                                    return
+                        put_or_abandon(("done", None))
+                    except BaseException as e:  # noqa: BLE001
+                        put_or_abandon(("error", e))
+
+                threading.Thread(target=pump, daemon=True).start()
+                self._streams[stream_id] = {"q": q, "finish": finish_stream}
+                streaming = True
+                return {self.STREAM_MARKER: stream_id}
             return out
         finally:
-            with self._lock:
-                self._ongoing -= 1
+            if not streaming:
+                with self._lock:
+                    self._ongoing -= 1
+
+    def next_chunks(self, stream_id: str, max_n: int = 8, timeout: float = 2.0):
+        """Pulls up to max_n chunks; returns (chunks, done). Short blocking
+        window so slow streams don't pin replica concurrency slots — the
+        consumer loops. Raises the generator's exception where it occurred."""
+        import queue as _queue
+
+        entry = self._streams.get(stream_id)
+        if entry is None:
+            raise KeyError(f"unknown stream {stream_id}")
+        q = entry["q"]
+        chunks: List[Any] = []
+        try:
+            kind, payload = q.get(timeout=timeout)
+        except _queue.Empty:
+            return chunks, False
+        while True:
+            if kind == "done":
+                entry["finish"]()
+                return chunks, True
+            if kind == "error":
+                entry["finish"]()
+                raise payload
+            chunks.append(payload)
+            if len(chunks) >= max_n:
+                return chunks, False
+            try:
+                kind, payload = q.get_nowait()
+            except _queue.Empty:
+                return chunks, False
 
     def queue_len(self) -> int:
         return self._ongoing

@@ -18,25 +18,74 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from .. import api
-from .controller import CONTROLLER_NAME
+from .controller import CONTROLLER_NAME, Replica
+
+_STREAM_MARKER = Replica.STREAM_MARKER  # single definition of the sentinel
 
 
 class DeploymentResponse:
     """Future-like response (reference: serve/handle.py DeploymentResponse)."""
 
-    def __init__(self, ref, on_done):
+    def __init__(self, ref, on_done, replica=None):
         self._ref = ref
         self._on_done = on_done
+        self._replica = replica
         self._done = False
 
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            self._on_done()
+
     def result(self, timeout: Optional[float] = None) -> Any:
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
         try:
             out = api.get(self._ref, timeout=timeout)
         finally:
-            if not self._done:
-                self._done = True
-                self._on_done()
+            self._finish()
+        if isinstance(out, dict) and _STREAM_MARKER in out:
+            # A generator response consumed non-streaming: drain it within
+            # the caller's deadline.
+            return list(self._iter_stream(out[_STREAM_MARKER], deadline))
         return out
+
+    def _iter_stream(self, stream_id: str, deadline: Optional[float] = None):
+        import time as _time
+
+        from .. import exceptions as exc
+
+        while True:
+            remaining = 60.0
+            if deadline is not None:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise exc.GetTimeoutError("stream drain timed out")
+            chunks, done = api.get(
+                self._replica.next_chunks.remote(stream_id),
+                timeout=min(60.0, remaining + 10.0),
+            )
+            yield from chunks
+            if done:
+                return
+
+
+class DeploymentResponseGenerator:
+    """Iterates a streaming deployment response chunk-by-chunk (reference:
+    serve/handle.py DeploymentResponseGenerator over the streaming
+    generator protocol)."""
+
+    def __init__(self, response: DeploymentResponse):
+        self._response = response
+
+    def __iter__(self):
+        out = api.get(self._response._ref, timeout=60)
+        self._response._finish()
+        if isinstance(out, dict) and _STREAM_MARKER in out:
+            yield from self._response._iter_stream(out[_STREAM_MARKER])
+        else:
+            yield out  # non-generator handler: a one-chunk stream
 
 
 class DeploymentHandle:
@@ -45,6 +94,7 @@ class DeploymentHandle:
     def __init__(self, app_name: str, method_name: str = "__call__"):
         self._app = app_name
         self._method = method_name
+        self._stream = False
         self._controller = api.get_actor(CONTROLLER_NAME)
         self._version = -1
         self._replicas: List[Any] = []
@@ -52,10 +102,15 @@ class DeploymentHandle:
         self._lock = threading.Lock()
         self._refresh()
 
-    def options(self, method_name: str) -> "DeploymentHandle":
+    def options(
+        self, method_name: Optional[str] = None, stream: Optional[bool] = None
+    ) -> "DeploymentHandle":
         h = DeploymentHandle.__new__(DeploymentHandle)
         h.__dict__.update(self.__dict__)
-        h._method = method_name
+        if method_name is not None:
+            h._method = method_name
+        if stream is not None:
+            h._stream = stream
         return h
 
     def _refresh(self, force: bool = False) -> None:
@@ -92,22 +147,150 @@ class DeploymentHandle:
                     self._outstanding[rid] -= 1
 
         ref = replica.handle_request.remote(self._method, args, kwargs)
-        return DeploymentResponse(ref, done)
+        response = DeploymentResponse(ref, done, replica=replica)
+        if self._stream:
+            return DeploymentResponseGenerator(response)
+        return response
 
 
 # ------------------------------------------------------------------ proxy
 
 
+class ProxyASGIApp:
+    """The proxy as an ASGI application (reference: proxy.py:874 HTTPProxy
+    — the ASGI callable served by uvicorn there). Any ASGI server can host
+    this app; the built-in _ProxyServer below runs it on a threaded stdlib
+    HTTP server via a minimal adapter. Routing: first path segment ->
+    deployment handle; generator handlers stream as chunked responses;
+    bytes bodies pass through untouched (non-JSON friendly)."""
+
+    def __init__(self, proxy: "_ProxyServer"):
+        self._proxy = proxy
+
+    async def __call__(self, scope, receive, send):
+        assert scope["type"] == "http"
+        path = scope["path"].strip("/")
+        app = path.split("/")[0] if path else ""
+
+        body = b""
+        while True:
+            message = await receive()
+            if message["type"] == "http.request":
+                body += message.get("body", b"")
+                if not message.get("more_body", False):
+                    break
+            elif message["type"] == "http.disconnect":
+                return
+
+        try:
+            handle = self._proxy._handle_for(app)
+        except Exception as e:  # noqa: BLE001
+            await self._respond_json(send, 404, {"error": f"no app {app!r}: {e}"})
+            return
+
+        headers = {k.decode().lower(): v.decode() for k, v in scope.get("headers", [])}
+        payload = self._decode_body(body, headers.get("content-type", ""))
+        sent_start = [False]
+
+        async def tracking_send(message):
+            if message["type"] == "http.response.start":
+                sent_start[0] = True
+            await send(message)
+
+        try:
+            stream = handle.options(stream=True).remote(*(() if payload is None else (payload,)))
+            await self._respond_stream(tracking_send, stream)
+        except Exception as e:  # noqa: BLE001
+            if sent_start[0]:
+                # Headers already on the wire: terminate the chunked body
+                # cleanly (the truncation is the error signal).
+                await send(
+                    {"type": "http.response.body", "body": b"", "more_body": False}
+                )
+            else:
+                await self._respond_json(send, 500, {"error": repr(e)})
+
+    @staticmethod
+    def _decode_body(body: bytes, content_type: str) -> Any:
+        if not body:
+            return None
+        try:
+            if "application/json" in content_type:
+                return json.loads(body)
+            if content_type.startswith("text/"):
+                return body.decode()
+            if not content_type:
+                return json.loads(body)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return body  # malformed declared type: raw passthrough
+        return body  # binary passthrough
+
+    @staticmethod
+    def _encode_chunk(chunk: Any) -> tuple:
+        if isinstance(chunk, bytes):
+            return chunk, "application/octet-stream"
+        if isinstance(chunk, str):
+            return chunk.encode(), "text/plain; charset=utf-8"
+        return json.dumps(chunk, default=str).encode(), "application/json"
+
+    async def _respond_stream(self, send, stream) -> None:
+        """Sends the handler's chunks as they arrive (chunked transfer).
+        The first chunk decides the content type. Blocking pulls run in the
+        executor so this app stays event-loop safe under any ASGI server.
+        """
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        it = iter(stream)
+        sentinel = object()
+
+        def pull():
+            return next(it, sentinel)
+
+        first = await loop.run_in_executor(None, pull)
+        if first is sentinel:
+            await self._respond_json(send, 200, None)
+            return
+        data, ctype = self._encode_chunk(first)
+        await send(
+            {
+                "type": "http.response.start",
+                "status": 200,
+                "headers": [(b"content-type", ctype.encode())],
+            }
+        )
+        await send({"type": "http.response.body", "body": data, "more_body": True})
+        while True:
+            chunk = await loop.run_in_executor(None, pull)
+            if chunk is sentinel:
+                break
+            data, _ = self._encode_chunk(chunk)
+            await send({"type": "http.response.body", "body": data, "more_body": True})
+        await send({"type": "http.response.body", "body": b"", "more_body": False})
+
+    async def _respond_json(self, send, status: int, payload: Any) -> None:
+        data = json.dumps(payload, default=str).encode()
+        await send(
+            {
+                "type": "http.response.start",
+                "status": status,
+                "headers": [(b"content-type", b"application/json")],
+            }
+        )
+        await send({"type": "http.response.body", "body": data, "more_body": False})
+
+
 class _ProxyServer:
-    """Minimal threaded HTTP/1.1 proxy (reference: proxy.py:1153
-    ProxyActor + HTTPProxy ASGI app at :779; here a stdlib server because
-    the data plane is JSON-over-HTTP round trips to replica actors)."""
+    """Hosts ProxyASGIApp on a threaded stdlib HTTP server through a
+    minimal ASGI adapter (chunked transfer for multi-part bodies). In a
+    production deployment the same app runs under any ASGI server."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         import http.server
         import socketserver
 
         proxy = self
+        asgi_app = ProxyASGIApp(self)
 
         class Handler(http.server.BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -115,41 +298,64 @@ class _ProxyServer:
             def log_message(self, *a):
                 pass
 
-            def _dispatch(self, body: Optional[bytes]):
-                path = self.path.strip("/").split("?")[0]
-                app = path.split("/")[0] if path else ""
-                try:
-                    handle = proxy._handle_for(app)
-                except Exception as e:
-                    self._send(404, {"error": f"no app {app!r}: {e}"})
-                    return
-                try:
-                    payload = json.loads(body) if body else None
-                except json.JSONDecodeError:
-                    payload = body.decode()
-                try:
-                    if payload is None:
-                        out = handle.remote().result(timeout=30)
-                    else:
-                        out = handle.remote(payload).result(timeout=30)
-                    self._send(200, out)
-                except Exception as e:  # noqa: BLE001
-                    self._send(500, {"error": repr(e)})
+            def _run_asgi(self, body: bytes):
+                import asyncio
+                from urllib.parse import urlsplit
 
-            def _send(self, code: int, payload: Any):
-                data = json.dumps(payload, default=str).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+                parts = urlsplit(self.path)
+                scope = {
+                    "type": "http",
+                    "asgi": {"version": "3.0"},
+                    "http_version": "1.1",
+                    "method": self.command,
+                    "path": parts.path,
+                    "raw_path": self.path.encode(),
+                    "query_string": parts.query.encode(),
+                    "headers": [
+                        (k.lower().encode(), v.encode()) for k, v in self.headers.items()
+                    ],
+                }
+                received = [False]
+
+                async def receive():
+                    if received[0]:
+                        return {"type": "http.disconnect"}
+                    received[0] = True
+                    return {"type": "http.request", "body": body, "more_body": False}
+
+                state = {"started": False, "chunked": False}
+
+                async def send(message):
+                    if message["type"] == "http.response.start":
+                        self.send_response(message["status"])
+                        for k, v in message.get("headers", []):
+                            self.send_header(k.decode(), v.decode())
+                        # Length unknown until the stream ends: chunked.
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        state["started"] = True
+                    elif message["type"] == "http.response.body":
+                        chunk = message.get("body", b"")
+                        if chunk:
+                            self.wfile.write(
+                                f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n"
+                            )
+                            self.wfile.flush()
+                        if not message.get("more_body", False):
+                            self.wfile.write(b"0\r\n\r\n")
+                            self.wfile.flush()
+
+                asyncio.run(asgi_app(scope, receive, send))
 
             def do_GET(self):
-                self._dispatch(None)
+                self._run_asgi(b"")
 
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
-                self._dispatch(self.rfile.read(n) if n else None)
+                self._run_asgi(self.rfile.read(n) if n else b"")
+
+            do_PUT = do_POST
+            do_DELETE = do_GET
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
