@@ -129,6 +129,9 @@ class Replica:
         if entry is None:
             raise KeyError(f"unknown stream {stream_id}")
         q = entry["q"]
+        if "pending_error" in entry:
+            entry["finish"]()
+            raise entry["pending_error"]
         chunks: List[Any] = []
         try:
             kind, payload = q.get(timeout=timeout)
@@ -139,6 +142,11 @@ class Replica:
                 entry["finish"]()
                 return chunks, True
             if kind == "error":
+                if chunks:
+                    # Deliver the chunks produced before the failure; the
+                    # error raises on the NEXT pull.
+                    entry["pending_error"] = payload
+                    return chunks, False
                 entry["finish"]()
                 raise payload
             chunks.append(payload)

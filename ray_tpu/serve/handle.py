@@ -22,6 +22,24 @@ from .controller import CONTROLLER_NAME, Replica
 
 _STREAM_MARKER = Replica.STREAM_MARKER  # single definition of the sentinel
 
+_stream_exec = None
+_stream_exec_lock = threading.Lock()
+
+
+def _stream_executor():
+    """Shared pool for blocking chunk pulls: per-request default executors
+    would churn threads on every streaming response."""
+    global _stream_exec
+    if _stream_exec is None:
+        import concurrent.futures
+
+        with _stream_exec_lock:
+            if _stream_exec is None:
+                _stream_exec = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=32, thread_name_prefix="serve-stream"
+                )
+    return _stream_exec
+
 
 class DeploymentResponse:
     """Future-like response (reference: serve/handle.py DeploymentResponse)."""
@@ -43,12 +61,18 @@ class DeploymentResponse:
         deadline = None if timeout is None else _time.monotonic() + timeout
         try:
             out = api.get(self._ref, timeout=timeout)
-        finally:
+        except BaseException:
             self._finish()
+            raise
         if isinstance(out, dict) and _STREAM_MARKER in out:
             # A generator response consumed non-streaming: drain it within
-            # the caller's deadline.
-            return list(self._iter_stream(out[_STREAM_MARKER], deadline))
+            # the caller's deadline. The replica stays "loaded" in the
+            # router's counters until the drain completes.
+            try:
+                return list(self._iter_stream(out[_STREAM_MARKER], deadline))
+            finally:
+                self._finish()
+        self._finish()
         return out
 
     def _iter_stream(self, stream_id: str, deadline: Optional[float] = None):
@@ -80,12 +104,16 @@ class DeploymentResponseGenerator:
         self._response = response
 
     def __iter__(self):
-        out = api.get(self._response._ref, timeout=60)
-        self._response._finish()
-        if isinstance(out, dict) and _STREAM_MARKER in out:
-            yield from self._response._iter_stream(out[_STREAM_MARKER])
-        else:
-            yield out  # non-generator handler: a one-chunk stream
+        # The outstanding counter holds until the stream is drained, so
+        # pow-2 routing sees long-lived streams as load.
+        try:
+            out = api.get(self._response._ref, timeout=60)
+            if isinstance(out, dict) and _STREAM_MARKER in out:
+                yield from self._response._iter_stream(out[_STREAM_MARKER])
+            else:
+                yield out  # non-generator handler: a one-chunk stream
+        finally:
+            self._response._finish()
 
 
 class DeploymentHandle:
@@ -202,13 +230,12 @@ class ProxyASGIApp:
             await self._respond_stream(tracking_send, stream)
         except Exception as e:  # noqa: BLE001
             if sent_start[0]:
-                # Headers already on the wire: terminate the chunked body
-                # cleanly (the truncation is the error signal).
-                await send(
-                    {"type": "http.response.body", "body": b"", "more_body": False}
-                )
-            else:
-                await self._respond_json(send, 500, {"error": repr(e)})
+                # Headers already on the wire: propagate so the server
+                # closes the connection WITHOUT the terminal chunk — a
+                # cleanly terminated chunked body would make the partial
+                # result indistinguishable from success.
+                raise
+            await self._respond_json(send, 500, {"error": repr(e)})
 
     @staticmethod
     def _decode_body(body: bytes, content_type: str) -> Any:
@@ -247,7 +274,7 @@ class ProxyASGIApp:
         def pull():
             return next(it, sentinel)
 
-        first = await loop.run_in_executor(None, pull)
+        first = await loop.run_in_executor(_stream_executor(), pull)
         if first is sentinel:
             await self._respond_json(send, 200, None)
             return
@@ -261,7 +288,7 @@ class ProxyASGIApp:
         )
         await send({"type": "http.response.body", "body": data, "more_body": True})
         while True:
-            chunk = await loop.run_in_executor(None, pull)
+            chunk = await loop.run_in_executor(_stream_executor(), pull)
             if chunk is sentinel:
                 break
             data, _ = self._encode_chunk(chunk)
@@ -289,7 +316,6 @@ class _ProxyServer:
         import http.server
         import socketserver
 
-        proxy = self
         asgi_app = ProxyASGIApp(self)
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -323,8 +349,6 @@ class _ProxyServer:
                     received[0] = True
                     return {"type": "http.request", "body": body, "more_body": False}
 
-                state = {"started": False, "chunked": False}
-
                 async def send(message):
                     if message["type"] == "http.response.start":
                         self.send_response(message["status"])
@@ -333,7 +357,6 @@ class _ProxyServer:
                         # Length unknown until the stream ends: chunked.
                         self.send_header("Transfer-Encoding", "chunked")
                         self.end_headers()
-                        state["started"] = True
                     elif message["type"] == "http.response.body":
                         chunk = message.get("body", b"")
                         if chunk:
@@ -345,17 +368,22 @@ class _ProxyServer:
                             self.wfile.write(b"0\r\n\r\n")
                             self.wfile.flush()
 
-                asyncio.run(asgi_app(scope, receive, send))
+                try:
+                    asyncio.run(asgi_app(scope, receive, send))
+                except Exception:  # noqa: BLE001
+                    # Mid-stream failure after headers: drop the connection
+                    # without the terminal chunk so the client observes a
+                    # truncated (failed) transfer, not a short success.
+                    self.close_connection = True
 
-            def do_GET(self):
-                self._run_asgi(b"")
-
-            def do_POST(self):
+            def _handle(self):
+                # Always drain the declared body (any method): leftover
+                # bytes would corrupt the next request on this keep-alive
+                # connection.
                 n = int(self.headers.get("Content-Length", 0))
                 self._run_asgi(self.rfile.read(n) if n else b"")
 
-            do_PUT = do_POST
-            do_DELETE = do_GET
+            do_GET = do_POST = do_PUT = do_DELETE = _handle
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
