@@ -682,6 +682,51 @@ class ClusterRuntime(Runtime):
             cli.close()
 
 
+def _session_alive(session_dir: str) -> bool:
+    """A session is alive iff its GCS socket accepts a connection."""
+    import socket
+
+    sock_path = os.path.join(session_dir, "gcs.sock")
+    if not os.path.exists(sock_path):
+        return False
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(0.2)
+    try:
+        s.connect(sock_path)
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def _sweep_orphaned_pools() -> None:
+    """Unlinks /dev/shm pools (and session dirs) of dead sessions: a
+    SIGKILLed driver never runs atexit, and tmpfs pages would otherwise
+    accumulate until /dev/shm fills (reference: ray's GC of old
+    /tmp/ray/session_* dirs)."""
+    import glob
+    import shutil
+
+    tmp = tempfile.gettempdir()
+    alive_cache: Dict[str, bool] = {}
+    for path in glob.glob("/dev/shm/rtpu_*"):
+        # Name layout: rtpu_<session_basename>_<node_id>.
+        base = os.path.basename(path)[len("rtpu_"):]
+        session_base = base.rsplit("_", 1)[0]
+        session_dir = os.path.join(tmp, session_base)
+        if session_base not in alive_cache:
+            alive_cache[session_base] = _session_alive(session_dir)
+        if not alive_cache[session_base]:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    for session_base, alive in alive_cache.items():
+        if not alive:
+            shutil.rmtree(os.path.join(tmp, session_base), ignore_errors=True)
+
+
 class Cluster:
     """Multi-node-on-one-machine test cluster (reference:
     python/ray/cluster_utils.py:135 Cluster, add_node :201, remove_node
@@ -697,6 +742,7 @@ class Cluster:
     ):
         from ..utils.config import CONFIG
 
+        _sweep_orphaned_pools()
         self.session_dir = tempfile.mkdtemp(prefix="ray_tpu_session_")
         self.gcs_sock = os.path.join(self.session_dir, "gcs.sock")
         self._procs: List[subprocess.Popen] = []

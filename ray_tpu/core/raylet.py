@@ -540,20 +540,13 @@ class RayletService:
                 if loc["node_id"] == self.node_id:
                     continue
                 try:
-                    raw = self._remote(loc["sock"]).call("fetch_object", oid_hex)
+                    if self._pull_from(loc["sock"], oid):
+                        self._notify_sealed([oid_hex], primary=False)
+                        return True
+                except exc.ObjectStoreFullError:
+                    break  # pins may drop; retry within the deadline
                 except Exception:
                     continue
-                if raw is not None:
-                    try:
-                        self.store.put_raw(oid, raw)
-                    except exc.ObjectStoreFullError:
-                        self.ensure_space(len(raw))
-                        try:
-                            self.store.put_raw(oid, raw)
-                        except exc.ObjectStoreFullError:
-                            break  # pins may drop; retry within the deadline
-                    self._notify_sealed([oid_hex], primary=False)
-                    return True
             if self.store.contains(oid):
                 return True
             time.sleep(0.01)
@@ -633,6 +626,86 @@ class RayletService:
                             exists_remote.add(h)
             with self._seal_cv:
                 self._seal_cv.wait(timeout=min(0.05, max(0.001, deadline - now)))
+
+    def _pull_from(self, sock: str, oid: ObjectID) -> bool:
+        """Fetches one object from a remote raylet. Small objects come in
+        one RPC; large ones stream in transfer_chunk_bytes pieces written
+        straight into the preallocated pool region (reference:
+        push_manager.h:30 / object_buffer_pool.h chunked transfer — a 1 GiB
+        object never needs a contiguous 1 GiB RPC buffer on either side)."""
+        remote = self._remote(sock)
+        oid_hex = oid.hex()
+        chunk = int(CONFIG.transfer_chunk_bytes)
+        size = remote.call("object_size", oid_hex)
+        if size is None:
+            return False
+        if size <= chunk:
+            raw = remote.call("fetch_object", oid_hex)
+            if raw is None:
+                return False
+            try:
+                self.store.put_raw(oid, raw)
+            except exc.ObjectStoreFullError:
+                self.ensure_space(len(raw))
+                self.store.put_raw(oid, raw)
+            return True
+        try:
+            pool_off = self.store.begin_put_raw(oid, size)
+        except exc.ObjectStoreFullError:
+            self.ensure_space(size)
+            pool_off = self.store.begin_put_raw(oid, size)
+        if pool_off is None:
+            return True  # concurrent pull won
+        sealed = False
+        try:
+            pos = 0
+            while pos < size:
+                piece = remote.call("fetch_object_chunk", oid_hex, pos, chunk)
+                if not piece:  # source evicted/died mid-transfer: abandon
+                    return False
+                self.store.write_raw_at(pool_off, pos, piece)
+                pos += len(piece)
+            self.store.finish_put_raw(oid)
+            sealed = True
+            return True
+        finally:
+            if not sealed:
+                # Delete the UNSEALED slot: sealing a truncated payload
+                # would hand readers corrupt data, and an orphaned CREATED
+                # slot would poison every later pull with EEXIST.
+                self.store.delete(oid)
+
+    def object_size(self, oid_hex: str) -> Optional[int]:
+        oid = ObjectID.from_hex(oid_hex)
+        size = self.store.raw_size(oid)
+        if size is not None:
+            return size
+        with self._spill_lock:
+            path = self._spilled.get(oid_hex)
+        if path is not None:
+            try:
+                return os.path.getsize(path)
+            except OSError:
+                return None
+        return None
+
+    def fetch_object_chunk(self, oid_hex: str, offset: int, length: int) -> Optional[bytes]:
+        """Serves one chunk of the framed payload (spilled objects read
+        from disk without restoring)."""
+        oid = ObjectID.from_hex(oid_hex)
+        piece = self.store.read_raw_chunk(oid, offset, length)
+        if piece is not None:
+            return piece
+        with self._spill_lock:
+            path = self._spilled.get(oid_hex)
+        if path is not None:
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    return f.read(length)
+            except OSError:
+                return None
+        return None
 
     def fetch_object(self, oid_hex: str) -> Optional[bytes]:
         """Serves the framed payload to a pulling raylet (the push half of

@@ -201,6 +201,57 @@ class SharedMemoryStore:
         self._mv[off.value : off.value + len(data)] = data
         self._lib.rtpu_seal(self._handle, oid.binary())
 
+    # --------------------------------------------- chunked transfer path
+    def begin_put_raw(self, oid: ObjectID, size: int) -> Optional[int]:
+        """Allocates an unsealed region for incremental chunk writes
+        (reference: plasma CreateAndSpillIfNeeded + the object manager
+        writing received chunks in place, object_buffer_pool.h). Returns
+        the pool offset, or None when the object already exists."""
+        off = ctypes.c_uint64()
+        rc = self._lib.rtpu_create(self._handle, oid.binary(), size, ctypes.byref(off))
+        if rc == -errno.EEXIST:
+            return None
+        if rc == -errno.ENOMEM:
+            raise exc.ObjectStoreFullError(
+                f"object of {size} bytes does not fit", nbytes=size
+            )
+        if rc != 0:
+            raise OSError(-rc, "rtpu_create failed")
+        return off.value
+
+    def write_raw_at(self, pool_offset: int, pos: int, data: bytes) -> None:
+        self._mv[pool_offset + pos : pool_offset + pos + len(data)] = data
+
+    def finish_put_raw(self, oid: ObjectID) -> None:
+        self._lib.rtpu_seal(self._handle, oid.binary())
+
+    def raw_size(self, oid: ObjectID) -> Optional[int]:
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rtpu_get(self._handle, oid.binary(), ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        try:
+            return size.value
+        finally:
+            self._lib.rtpu_release(self._handle, oid.binary())
+
+    def read_raw_chunk(self, oid: ObjectID, chunk_off: int, length: int) -> Optional[bytes]:
+        """Copies one chunk of the framed payload out (pinned only for the
+        duration of the copy)."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rtpu_get(self._handle, oid.binary(), ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        try:
+            end = min(size.value, chunk_off + length)
+            if chunk_off >= size.value:
+                return b""
+            return bytes(self._mv[off.value + chunk_off : off.value + end])
+        finally:
+            self._lib.rtpu_release(self._handle, oid.binary())
+
     # ------------------------------------------------------------------- get
     def get(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
         """Fetches and deserializes; with a timeout, waits for a concurrent

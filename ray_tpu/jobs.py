@@ -1,0 +1,170 @@
+"""Job submission: run an entrypoint command against a live cluster.
+
+Re-design of the reference's job stack (reference:
+python/ray/dashboard/modules/job/job_manager.py:59 JobManager.submit_job,
+job_supervisor.py — a supervisor actor per job driving the entrypoint
+subprocess; client python/ray/dashboard/modules/job/sdk.py
+JobSubmissionClient). The job table lives in the GCS KV store (persisted
+with GCS snapshots); logs land in the session log directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from . import api
+from .core import runtime_base
+
+_JOB_PREFIX = "job:"
+
+
+class _JobSupervisor:
+    """Actor body: owns one job's entrypoint subprocess (reference:
+    job_supervisor.py). Runs on any node; the entrypoint gets
+    RAY_TPU_ADDRESS so `ray_tpu.init(address=...)` attaches to this
+    cluster."""
+
+    def __init__(self, job_id: str, entrypoint: str, session_dir: str, env: Dict[str, str]):
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.session_dir = session_dir
+        self.env = env
+        self.proc: Optional[subprocess.Popen] = None
+
+    def run(self) -> Dict[str, Any]:
+        """Runs the entrypoint to completion; returns the final status."""
+        log_path = os.path.join(self.session_dir, "logs", f"job_{self.job_id}.log")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        env = dict(os.environ)
+        env.update(self.env)
+        env["RAY_TPU_ADDRESS"] = self.session_dir
+        env["RAY_TPU_JOB_ID"] = self.job_id
+        # The entrypoint must resolve the framework even when ray_tpu runs
+        # from a source checkout rather than site-packages.
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        self._set_status("RUNNING", pid=None)
+        with open(log_path, "ab", buffering=0) as log:
+            self.proc = subprocess.Popen(
+                self.entrypoint, shell=True, stdout=log, stderr=log, env=env
+            )
+            self._set_status("RUNNING", pid=self.proc.pid)
+            rc = self.proc.wait()
+        status = "SUCCEEDED" if rc == 0 else "FAILED"
+        self._set_status(status, returncode=rc)
+        return {"job_id": self.job_id, "status": status, "returncode": rc}
+
+    def stop(self) -> bool:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+            self._set_status("STOPPED")
+            return True
+        return False
+
+    def _set_status(self, status: str, **extra) -> None:
+        rt = runtime_base.current_runtime()
+        rec = {"job_id": self.job_id, "entrypoint": self.entrypoint,
+               "status": status, "ts": time.time()}
+        rec.update(extra)
+        rt._gcs.call("kv_put", _JOB_PREFIX + self.job_id, json.dumps(rec).encode())
+
+
+class JobSubmissionClient:
+    """(reference: dashboard/modules/job/sdk.py JobSubmissionClient —
+    HTTP there, direct GCS/actor calls here.)"""
+
+    def __init__(self, address: Optional[str] = None):
+        if address and not runtime_base.is_initialized():
+            api.init(address=address)
+        self._rt = runtime_base.current_runtime()
+        self._supervisors: Dict[str, Any] = {}
+        self._result_refs: Dict[str, Any] = {}
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        runtime_env: Optional[dict] = None,
+        job_id: Optional[str] = None,
+    ) -> str:
+        job_id = job_id or f"raytpu-job-{uuid.uuid4().hex[:8]}"
+        session_dir = getattr(self._rt, "_session_dir", None) or os.path.dirname(
+            self._rt._raylet.path
+        )
+        env_vars = dict((runtime_env or {}).get("env_vars") or {})
+        rec = {"job_id": job_id, "entrypoint": entrypoint, "status": "PENDING",
+               "ts": time.time()}
+        self._rt._gcs.call("kv_put", _JOB_PREFIX + job_id, json.dumps(rec).encode())
+        sup_cls = api.remote(num_cpus=0.1, max_concurrency=2)(_JobSupervisor)
+        sup = sup_cls.remote(job_id, entrypoint, session_dir, env_vars)
+        self._supervisors[job_id] = sup
+        self._result_refs[job_id] = sup.run.remote()
+        return job_id
+
+    def get_job_status(self, job_id: str) -> str:
+        raw = self._rt._gcs.call("kv_get", _JOB_PREFIX + job_id)
+        if raw is None:
+            raise KeyError(f"no such job {job_id!r}")
+        return json.loads(raw)["status"]
+
+    def get_job_info(self, job_id: str) -> Dict[str, Any]:
+        raw = self._rt._gcs.call("kv_get", _JOB_PREFIX + job_id)
+        if raw is None:
+            raise KeyError(f"no such job {job_id!r}")
+        return json.loads(raw)
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        keys = self._rt._gcs.call("kv_keys", _JOB_PREFIX)
+        out = []
+        for k in keys:
+            raw = self._rt._gcs.call("kv_get", k)
+            if raw:
+                out.append(json.loads(raw))
+        return sorted(out, key=lambda r: r.get("ts", 0))
+
+    def get_job_logs(self, job_id: str) -> str:
+        session_dir = getattr(self._rt, "_session_dir", None) or os.path.dirname(
+            self._rt._raylet.path
+        )
+        path = os.path.join(session_dir, "logs", f"job_{job_id}.log")
+        try:
+            with open(path) as f:
+                return f.read()
+        except OSError:
+            return ""
+
+    def stop_job(self, job_id: str) -> bool:
+        sup = self._supervisors.get(job_id)
+        if sup is None:
+            return False
+        return api.get(sup.stop.remote(), timeout=30)
+
+    def wait_until_finished(self, job_id: str, timeout: float = 300.0) -> str:
+        from . import exceptions as exc
+
+        ref = self._result_refs.get(job_id)
+        if ref is not None:
+            try:
+                api.get(ref, timeout=timeout)
+            except exc.GetTimeoutError:
+                pass  # still running: report the current status
+            return self.get_job_status(job_id)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self.get_job_status(job_id)
+            if st in ("SUCCEEDED", "FAILED", "STOPPED"):
+                return st
+            time.sleep(0.5)
+        return self.get_job_status(job_id)

@@ -13,6 +13,7 @@ and learners):
 
     "data"    - pure data parallelism (batch split, gradient psum)
     "fsdp"    - sharded data parallelism (params/opt-state sharded, ZeRO-3)
+    "stage"   - pipeline parallelism (GPipe microbatches over ppermute)
     "tensor"  - tensor/model parallelism (weight matrices split)
     "seq"     - sequence/context parallelism (ring attention / Ulysses)
     "expert"  - expert parallelism (MoE dispatch)
@@ -42,8 +43,9 @@ def default_devices() -> List[jax.Device]:
     return list(jax.devices(platform) if platform else jax.devices())
 
 # Canonical axis order: outermost (slowest-varying, cheapest link) first.
-# data/fsdp ride DCN across hosts if they must; tensor/seq/expert want ICI.
-AXIS_ORDER = ("data", "fsdp", "expert", "seq", "tensor")
+# data/fsdp/stage ride DCN across hosts if they must (pipeline transfers
+# are point-to-point and latency-tolerant); tensor/seq/expert want ICI.
+AXIS_ORDER = ("data", "fsdp", "stage", "expert", "seq", "tensor")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,6 +96,7 @@ class MeshSpec:
 
     data: int = -1
     fsdp: int = 1
+    stage: int = 1
     tensor: int = 1
     seq: int = 1
     expert: int = 1
@@ -102,6 +105,7 @@ class MeshSpec:
         sizes = {
             "data": self.data,
             "fsdp": self.fsdp,
+            "stage": self.stage,
             "expert": self.expert,
             "seq": self.seq,
             "tensor": self.tensor,

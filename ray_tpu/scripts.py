@@ -1,0 +1,205 @@
+"""`ray-tpu` CLI: start/stop/status/submit/logs against a local cluster.
+
+Re-design of the reference's CLI (reference: python/ray/scripts/scripts.py:626
+`ray start` / `ray stop` / `ray status`; job commands from
+dashboard/modules/job/cli.py). The head's session directory is the
+address; `start` records it at ~/.ray_tpu/latest_session so later
+commands find the cluster without arguments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+_SESSION_POINTER = os.path.expanduser("~/.ray_tpu/latest_session")
+
+
+def _record_session(session_dir: str) -> None:
+    os.makedirs(os.path.dirname(_SESSION_POINTER), exist_ok=True)
+    with open(_SESSION_POINTER, "w") as f:
+        f.write(session_dir)
+
+
+def _resolve_address(args) -> str:
+    if getattr(args, "address", None):
+        return args.address
+    try:
+        with open(_SESSION_POINTER) as f:
+            return f.read().strip()
+    except OSError:
+        raise SystemExit("no running cluster found; pass --address or run `ray-tpu start`")
+
+
+def cmd_start(args) -> None:
+    import atexit
+
+    from .core.cluster_runtime import Cluster
+
+    resources = json.loads(args.resources) if args.resources else None
+    cluster = Cluster(
+        num_cpus=args.num_cpus,
+        num_tpus=args.num_tpus,
+        resources=resources,
+        object_store_memory=args.object_store_memory,
+    )
+    # The daemons must outlive this CLI process (reference: `ray start`
+    # leaves raylets running): drop the kill-children atexit hook.
+    atexit.unregister(cluster._cleanup)
+    pids = [p.pid for p in cluster._procs]
+    with open(os.path.join(cluster.session_dir, "pids.json"), "w") as f:
+        json.dump(pids, f)
+    _record_session(cluster.session_dir)
+    print(f"started cluster; session dir: {cluster.session_dir}")
+    print(f"connect with: ray_tpu.init(address={cluster.session_dir!r})")
+
+
+def cmd_stop(args) -> None:
+    session = _resolve_address(args)
+    try:
+        with open(os.path.join(session, "pids.json")) as f:
+            pids = json.load(f)
+    except OSError:
+        pids = []
+    from .core.rpc import RpcClient
+
+    try:
+        info = json.load(open(os.path.join(session, "session.json")))
+        RpcClient(info["gcs_sock"], connect_timeout=2.0).call("stop", timeout=2.0)
+    except Exception:
+        pass
+    time.sleep(0.2)
+    killed = 0
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGTERM)
+            killed += 1
+        except OSError:
+            pass
+    time.sleep(0.3)
+    # Reclaim tmpfs pools + session state: nothing else unlinks them once
+    # the CLI detached the cluster from the atexit cleanup.
+    import glob
+    import shutil
+
+    for store in glob.glob(f"/dev/shm/rtpu_{os.path.basename(session)}_*"):
+        try:
+            os.unlink(store)
+        except OSError:
+            pass
+    shutil.rmtree(session, ignore_errors=True)
+    try:
+        os.unlink(_SESSION_POINTER)
+    except OSError:
+        pass
+    print(f"stopped {killed} cluster processes")
+
+
+def _connect(args):
+    from . import api
+
+    api.init(address=_resolve_address(args), ignore_reinit_error=True)
+
+
+def cmd_status(args) -> None:
+    _connect(args)
+    from .utils import state
+
+    stats = state.cluster_stats()
+    print(f"nodes alive: {stats['nodes_alive']}")
+    for n in state.list_nodes():
+        mark = "up" if n["Alive"] else "DOWN"
+        print(
+            f"  [{mark}] {n['NodeID'][:12]} resources={n['Resources']} "
+            f"available={n['Available']} workers={n['Stats'].get('num_workers', 0)}"
+        )
+    print(f"tasks: {stats['tasks']}")
+    print(f"actors: {stats['actors']}")
+    s = stats["store"]
+    print(
+        f"object store: {s['num_objects']} objects, "
+        f"{s['bytes_in_use'] / (1 << 20):.1f} MiB in use, {s['num_spilled']} spilled"
+    )
+
+
+def cmd_submit(args) -> None:
+    import shlex
+
+    _connect(args)
+    from .jobs import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    parts = list(args.entrypoint)
+    if parts and parts[0] == "--":  # argparse.REMAINDER keeps the separator
+        parts = parts[1:]
+    entrypoint = " ".join(shlex.quote(p) for p in parts)
+    job_id = client.submit_job(entrypoint=entrypoint)
+    print(f"submitted {job_id}: {entrypoint}")
+    if args.wait:
+        status = client.wait_until_finished(job_id, timeout=args.timeout)
+        print(f"{job_id}: {status}")
+        sys.stdout.write(client.get_job_logs(job_id))
+        if status != "SUCCEEDED":
+            raise SystemExit(1)
+
+
+def cmd_jobs(args) -> None:
+    _connect(args)
+    from .jobs import JobSubmissionClient
+
+    for rec in JobSubmissionClient().list_jobs():
+        print(f"{rec['job_id']}  {rec['status']:<10} {rec['entrypoint']}")
+
+
+def cmd_logs(args) -> None:
+    _connect(args)
+    from .jobs import JobSubmissionClient
+
+    sys.stdout.write(JobSubmissionClient().get_job_logs(args.job_id))
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="ray-tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a local cluster head")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--resources", default=None, help="JSON dict of custom resources")
+    p.add_argument("--object-store-memory", type=int, default=None)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop the cluster")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="cluster nodes/tasks/store summary")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("submit", help="submit a job entrypoint command")
+    p.add_argument("--address", default=None)
+    p.add_argument("--wait", action="store_true", help="block until the job finishes")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("jobs", help="list submitted jobs")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_jobs)
+
+    p = sub.add_parser("logs", help="print a job's captured output")
+    p.add_argument("--address", default=None)
+    p.add_argument("job_id")
+    p.set_defaults(fn=cmd_logs)
+
+    args = ap.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

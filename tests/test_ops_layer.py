@@ -1,0 +1,139 @@
+"""Ops layer: job submission, autoscaler, CLI (reference:
+dashboard/modules/job/job_manager.py:59, autoscaler/v2/autoscaler.py:42,
+scripts/scripts.py:626)."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.core import runtime_base
+from ray_tpu.core.cluster_runtime import Cluster
+
+
+@pytest.fixture
+def cluster():
+    rt.shutdown()
+    c = Cluster(num_cpus=2)
+    runtime = c.runtime()
+    runtime_base.set_runtime(runtime)
+    yield c, runtime
+    rt.shutdown()
+
+
+# ------------------------------------------------------------------- jobs
+def test_job_submit_and_logs(cluster, tmp_path):
+    from ray_tpu.jobs import JobSubmissionClient
+
+    script = tmp_path / "job.py"
+    script.write_text(
+        "import os\n"
+        "import ray_tpu as rt\n"
+        "rt.init(address=os.environ['RAY_TPU_ADDRESS'])\n"
+        "@rt.remote\n"
+        "def f(x):\n"
+        "    return x * 3\n"
+        "print('job result:', rt.get(f.remote(14)))\n"
+        "rt.shutdown()\n"
+    )
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"{sys.executable} {script}")
+    status = client.wait_until_finished(job_id, timeout=180)
+    logs = client.get_job_logs(job_id)
+    assert status == "SUCCEEDED", logs
+    assert "job result: 42" in logs
+    assert any(j["job_id"] == job_id for j in client.list_jobs())
+
+
+def test_job_failure_reported(cluster):
+    from ray_tpu.jobs import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    status = client.wait_until_finished(job_id, timeout=120)
+    assert status == "FAILED"
+    assert client.get_job_info(job_id)["returncode"] == 3
+
+
+def test_job_stop(cluster):
+    from ray_tpu.jobs import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(120)'"
+    )
+    deadline = time.monotonic() + 60
+    while client.get_job_status(job_id) != "RUNNING" and time.monotonic() < deadline:
+        time.sleep(0.2)
+    assert client.stop_job(job_id)
+    assert client.wait_until_finished(job_id, timeout=30) == "STOPPED"
+
+
+# ------------------------------------------------------------- autoscaler
+def test_autoscaler_scales_up_and_down(cluster):
+    from ray_tpu.autoscaler import Autoscaler, LocalNodeProvider
+
+    c, runtime = cluster
+
+    @rt.remote(num_cpus=2)
+    def hold(t):
+        time.sleep(t)
+        return 1
+
+    scaler = Autoscaler(
+        LocalNodeProvider(c, num_cpus_per_node=2),
+        min_nodes=1,
+        max_nodes=3,
+        upscale_delay_s=1.0,
+        idle_timeout_s=3.0,
+        interval_s=0.5,
+    )
+    scaler.start()
+    try:
+        # 3 gang-width tasks against 1 two-CPU node: sustained starvation.
+        refs = [hold.remote(6.0) for _ in range(3)]
+        deadline = time.monotonic() + 40
+        while scaler.num_upscales < 1 and time.monotonic() < deadline:
+            time.sleep(0.3)
+        assert scaler.num_upscales >= 1, "no upscale despite starved queue"
+        assert rt.get(refs, timeout=120) == [1, 1, 1]
+        # Load gone: managed nodes idle out and are removed.
+        deadline = time.monotonic() + 40
+        while scaler.num_downscales < scaler.num_upscales and time.monotonic() < deadline:
+            time.sleep(0.5)
+        assert scaler.num_downscales >= 1, "idle managed node never released"
+    finally:
+        scaler.stop()
+
+
+# -------------------------------------------------------------------- cli
+def test_cli_start_status_submit_stop(tmp_path):
+    env = dict(__import__("os").environ)
+    env["HOME"] = str(tmp_path)  # isolate ~/.ray_tpu/latest_session
+
+    def cli(*args, timeout=240):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts", *args],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            env=env,
+            cwd="/root/repo",
+        )
+
+    out = cli("start", "--num-cpus", "2")
+    assert out.returncode == 0, out.stderr
+    assert "session dir" in out.stdout
+    try:
+        st = cli("status")
+        assert st.returncode == 0, st.stderr
+        assert "nodes alive: 1" in st.stdout
+
+        sub = cli("submit", "--wait", "--", sys.executable, "-c", "print('cli-job-ok')")
+        assert sub.returncode == 0, sub.stderr + sub.stdout
+        assert "cli-job-ok" in sub.stdout
+    finally:
+        stop = cli("stop")
+        assert stop.returncode == 0, stop.stderr

@@ -32,7 +32,7 @@ def test_mesh_resolution_wildcard():
 
 def test_mesh_axis_order_canonical():
     m = build_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
-    assert m.axis_names == ("data", "fsdp", "expert", "seq", "tensor")
+    assert m.axis_names == ("data", "fsdp", "stage", "expert", "seq", "tensor")
 
 
 def test_mesh_bad_sizes():
@@ -120,3 +120,92 @@ def test_ring_attention_jit_grad():
     g = jax.jit(jax.grad(loss))(q, k, v)
     assert g.shape == q.shape
     assert bool(jnp.all(jnp.isfinite(g)))
+
+
+# ------------------------------------------------------------- round 3: PP
+class TestPipelineParallel:
+    """GPipe pipeline over the "stage" mesh axis (the TPU-native inversion
+    of the reference's compiled-graph channel PP, dag/compiled_dag_node.py:
+    the pipeline IS the compiled program; ppermute replaces channels)."""
+
+    def _mesh(self, n_stages):
+        import jax
+        from ray_tpu.parallel.mesh import build_mesh, MeshSpec
+
+        return build_mesh(
+            MeshSpec(data=1, stage=n_stages),
+            devices=jax.devices("cpu")[:n_stages],
+        )
+
+    def test_forward_matches_sequential(self):
+        import jax
+        import jax.numpy as jnp
+        from ray_tpu.parallel.pipeline import (
+            pipeline_apply,
+            shard_stage_params,
+            stack_stage_params,
+        )
+
+        S, M, mb, d = 4, 8, 2, 16
+        keys = jax.random.split(jax.random.PRNGKey(0), S)
+        stages = [
+            {"w": jax.random.normal(k, (d, d)) * 0.3, "b": jnp.zeros((d,))}
+            for k in keys
+        ]
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        x = jax.random.normal(jax.random.PRNGKey(1), (M, mb, d))
+        # sequential reference
+        ref = x
+        for p in stages:
+            ref = jax.vmap(lambda xb, p=p: stage_fn(p, xb))(ref)
+
+        mesh = self._mesh(S)
+        params = shard_stage_params(stack_stage_params(stages), mesh)
+        out = pipeline_apply(stage_fn, params, x, mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_backward_pipeline_grad_parity(self):
+        import jax
+        import jax.numpy as jnp
+        from ray_tpu.parallel.pipeline import (
+            pipeline_apply,
+            stack_stage_params,
+        )
+
+        S, M, mb, d = 2, 4, 2, 8
+        keys = jax.random.split(jax.random.PRNGKey(2), S)
+        stages = [{"w": jax.random.normal(k, (d, d)) * 0.3} for k in keys]
+        stacked = stack_stage_params(stages)
+        x = jax.random.normal(jax.random.PRNGKey(3), (M, mb, d))
+        mesh = self._mesh(S)
+
+        def stage_fn(p, xb):
+            return jnp.tanh(xb @ p["w"])
+
+        def loss_pp(params):
+            return jnp.mean(pipeline_apply(stage_fn, params, x, mesh) ** 2)
+
+        def loss_seq(params):
+            y = x
+            for s in range(S):
+                y = jnp.tanh(y @ params["w"][s])
+            return jnp.mean(y ** 2)
+
+        g_pp = jax.grad(loss_pp)(stacked)
+        g_seq = jax.grad(loss_seq)(stacked)
+        np.testing.assert_allclose(
+            np.asarray(g_pp["w"]), np.asarray(g_seq["w"]), rtol=2e-4, atol=2e-5
+        )
+
+    def test_transformer_layers_split_into_stages(self):
+        import jax
+        import jax.numpy as jnp
+        from ray_tpu.parallel.pipeline import split_stacked_layers
+
+        stacked = {"w": jnp.zeros((8, 4, 4)), "b": jnp.zeros((8, 4))}
+        staged = split_stacked_layers(stacked, 4)
+        assert staged["w"].shape == (4, 2, 4, 4)
+        assert staged["b"].shape == (4, 2, 4)

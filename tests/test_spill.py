@@ -43,3 +43,28 @@ def test_task_outputs_spill(small_pool):
         v = rt.get(ref, timeout=120)
         assert v[0] == i
         del v
+
+
+def test_chunked_cross_node_transfer():
+    """A large object pulls across nodes in transfer_chunk_bytes pieces
+    (reference: push_manager.h:30 chunked transfer)."""
+    import ray_tpu as rt
+    from ray_tpu.core import runtime_base
+    from ray_tpu.core.cluster_runtime import Cluster
+
+    rt.shutdown()
+    cluster = Cluster(num_cpus=2, object_store_memory=256 << 20)
+    runtime = cluster.runtime()
+    runtime_base.set_runtime(runtime)
+    cluster.add_node(num_cpus=2, resources={"far": 1.0})
+    try:
+        @rt.remote(resources={"far": 1.0})
+        def produce():
+            return np.arange(24 << 20, dtype=np.uint8)  # 24MB > 8MB chunks
+
+        ref = produce.remote()
+        v = rt.get(ref, timeout=120)  # pulled to the head node chunk-wise
+        assert v.nbytes == 24 << 20
+        assert v[0] == 0 and v[255] == 255 and int(v[(24 << 20) - 1]) == ((24 << 20) - 1) % 256
+    finally:
+        rt.shutdown()
