@@ -17,6 +17,12 @@ from .module import (
     logp_entropy,
     sample_actions,
 )
+from .multi_agent import (
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from .ppo import PPO, PPOConfig, compute_gae, ppo_loss
 from .replay import TransitionReplayBuffer
 
@@ -27,5 +33,6 @@ __all__ = [
     "GaussianPolicyConfig", "GaussianPolicyModule", "build_module_for_env",
     "logp_entropy", "sample_actions", "PPO", "PPOConfig", "compute_gae",
     "ppo_loss", "DQN", "DQNConfig", "QModule", "dqn_loss",
-    "TransitionReplayBuffer",
+    "TransitionReplayBuffer", "MultiAgentEnv", "MultiAgentEnvRunner",
+    "MultiAgentPPO", "MultiAgentPPOConfig",
 ]
