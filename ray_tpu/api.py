@@ -218,6 +218,13 @@ class RemoteFunction:
         rf._blob, rf._hash = self._blob, self._hash
         return rf
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node for this function (reference:
+        python/ray/dag — fn.bind(...) authoring surface)."""
+        from .dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         rt = current_runtime()
         blob, fhash = self._materialize()
@@ -257,6 +264,12 @@ class ActorMethod:
     def options(self, **opts) -> "ActorMethod":
         m = ActorMethod(self._handle, self._method_name, opts.get("num_returns", self._num_returns))
         return m
+
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node for this actor method (reference: ray.dag)."""
+        from .dag import ClassMethodNode
+
+        return ClassMethodNode(self, args, kwargs)
 
     def remote(self, *args, **kwargs):
         return self._handle._invoke(self._method_name, args, kwargs, self._num_returns)
