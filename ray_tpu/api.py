@@ -89,6 +89,12 @@ def init(
         if ignore_reinit_error:
             return
         raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+    if address is None:
+        # RAY_ADDRESS parity: job entrypoints and shells attach to the
+        # cluster recorded in the environment.
+        import os
+
+        address = os.environ.get("RAY_TPU_ADDRESS") or None
     if local_mode:
         from .core.local_runtime import LocalRuntime
 

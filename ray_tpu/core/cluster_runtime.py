@@ -123,6 +123,63 @@ class ClusterRuntime(Runtime):
             target=self._free_loop, daemon=True, name="free"
         )
         self._free_thread.start()
+        # Stream worker stdout/stderr to the driver console (reference:
+        # log_monitor.py tailing worker logs to the driver; disable with
+        # RAY_TPU_LOG_TO_DRIVER=0).
+        if driver and os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+            threading.Thread(
+                target=self._stream_logs, daemon=True, name="logmon"
+            ).start()
+
+    def _stream_logs(self) -> None:
+        session = self._session_dir or os.path.dirname(self._raylet.path)
+        log_dir = os.path.join(session, "logs")
+        offsets: Dict[str, int] = {}
+        # Stream only output produced AFTER this driver attached: replaying
+        # a long-lived cluster's history (or other jobs' output) floods the
+        # console (reference: log_monitor.py streams from attach time).
+        try:
+            for name in os.listdir(log_dir):
+                path = os.path.join(log_dir, name)
+                try:
+                    offsets[name] = os.path.getsize(path)
+                except OSError:
+                    pass
+        except OSError:
+            pass
+        while not self._shutdown_done:
+            time.sleep(0.5)
+            try:
+                names = sorted(os.listdir(log_dir))
+            except OSError:
+                continue
+            for name in names:
+                if not name.startswith("worker_"):
+                    continue
+                path = os.path.join(log_dir, name)
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    continue
+                pos = offsets.get(name, 0)
+                if size <= pos:
+                    continue
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(pos)
+                        data = f.read(size - pos)
+                except OSError:
+                    continue
+                # Consume only whole lines: a write landing mid-poll would
+                # otherwise print as two fragments (and could split a
+                # multibyte character).
+                cut = data.rfind(b"\n")
+                if cut < 0:
+                    continue  # partial line: wait for the newline
+                offsets[name] = pos + cut + 1
+                tag = name.rsplit(".", 1)[0]
+                for line in data[: cut + 1].decode(errors="replace").splitlines():
+                    print(f"({tag}) {line}", flush=True)
 
     # ------------------------------------------------------------ factory
     @classmethod

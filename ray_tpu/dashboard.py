@@ -1,0 +1,142 @@
+"""Dashboard: HTTP view of cluster state.
+
+Re-design of the reference's dashboard (reference:
+python/ray/dashboard/dashboard.py + modules/node|actor|job APIs — an aiohttp
+app with a React frontend). Here: a stdlib HTTP server exposing the state
+API as JSON (`/api/nodes`, `/api/actors`, `/api/tasks`, `/api/objects`,
+`/api/jobs`, `/api/stats`, `/api/placement_groups`) plus a self-contained
+HTML overview at `/` — enough for `curl`/browser inspection without a
+frontend build.
+
+    from ray_tpu.dashboard import start_dashboard
+    port = start_dashboard(port=8265)
+    # or: ray-tpu dashboard
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+body { font-family: monospace; margin: 2em; }
+table { border-collapse: collapse; margin-bottom: 2em; }
+td, th { border: 1px solid #999; padding: 4px 8px; text-align: left; }
+h2 { margin-bottom: 0.3em; }
+</style></head>
+<body>
+<h1>ray_tpu cluster</h1>
+<div id="content">loading...</div>
+<script>
+// User-controlled strings (names, entrypoints) must never reach innerHTML raw.
+const esc = s => String(s).replace(/[&<>"']/g,
+  c => ({"&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"}[c]));
+async function refresh() {
+  const [stats, nodes, actors, jobs] = await Promise.all(
+    ["stats", "nodes", "actors", "jobs"].map(p => fetch("/api/" + p).then(r => r.json())));
+  let html = "<h2>Stats</h2><pre>" + esc(JSON.stringify(stats, null, 2)) + "</pre>";
+  html += "<h2>Nodes</h2><table><tr><th>id</th><th>alive</th><th>resources</th><th>available</th></tr>";
+  for (const n of nodes) html += `<tr><td>${esc(n.NodeID.slice(0,12))}</td><td>${n.Alive}</td>` +
+    `<td>${esc(JSON.stringify(n.Resources))}</td><td>${esc(JSON.stringify(n.Available))}</td></tr>`;
+  html += "</table><h2>Actors</h2><table><tr><th>id</th><th>state</th><th>name</th><th>restarts</th></tr>";
+  for (const a of actors) html += `<tr><td>${esc(a.actor_id.slice(0,12))}</td><td>${esc(a.state)}</td>` +
+    `<td>${esc(a.name || "")}</td><td>${a.num_restarts}</td></tr>`;
+  html += "</table><h2>Jobs</h2><table><tr><th>id</th><th>status</th><th>entrypoint</th></tr>";
+  for (const j of jobs) html += `<tr><td>${esc(j.job_id)}</td><td>${esc(j.status)}</td><td>${esc(j.entrypoint)}</td></tr>`;
+  html += "</table>";
+  document.getElementById("content").innerHTML = html;
+}
+refresh(); setInterval(refresh, 2000);
+</script>
+</body></html>
+"""
+
+
+class _Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        import http.server
+        import socketserver
+
+        from .core import runtime_base
+
+        rt = runtime_base.current_runtime()
+        gcs = rt._gcs
+
+        def collect(path: str) -> Any:
+            if path == "nodes":
+                return gcs.call("list_nodes")
+            if path == "actors":
+                return gcs.call("list_actors", 1000)
+            if path == "tasks":
+                return gcs.call("list_tasks", 1000)
+            if path == "objects":
+                return gcs.call("list_objects", 1000)
+            if path == "placement_groups":
+                return gcs.call("placement_group_table")
+            if path == "stats":
+                return gcs.call("stats")
+            if path == "jobs":
+                from .jobs import list_job_records
+
+                return list_job_records(gcs)
+            raise KeyError(path)
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path in ("/", "/index.html"):
+                    body = _PAGE.encode()
+                    ctype = "text/html; charset=utf-8"
+                    code = 200
+                elif self.path.startswith("/api/"):
+                    try:
+                        body = json.dumps(collect(self.path[len("/api/"):]), default=str).encode()
+                        ctype = "application/json"
+                        code = 200
+                    except KeyError:
+                        body, ctype, code = b'{"error": "unknown endpoint"}', "application/json", 404
+                    except Exception as e:  # noqa: BLE001
+                        body = json.dumps({"error": repr(e)}).encode()
+                        ctype, code = "application/json", 500
+                else:
+                    body, ctype, code = b"not found", "text/plain", 404
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+_dashboard: Optional[_Dashboard] = None
+
+
+def start_dashboard(port: int = 8265, host: str = "127.0.0.1") -> int:
+    """Starts (or returns) the dashboard; returns the bound port."""
+    global _dashboard
+    if _dashboard is None:
+        _dashboard = _Dashboard(host=host, port=port)
+    return _dashboard.port
+
+
+def stop_dashboard() -> None:
+    global _dashboard
+    if _dashboard is not None:
+        _dashboard.shutdown()
+        _dashboard = None

@@ -24,6 +24,17 @@ from .core import runtime_base
 _JOB_PREFIX = "job:"
 
 
+def list_job_records(gcs) -> List[Dict[str, Any]]:
+    """All job records from the GCS job table, oldest first (shared by the
+    client and the dashboard)."""
+    out = []
+    for k in gcs.call("kv_keys", _JOB_PREFIX):
+        raw = gcs.call("kv_get", k)
+        if raw:
+            out.append(json.loads(raw))
+    return sorted(out, key=lambda r: r.get("ts", 0))
+
+
 class _JobSupervisor:
     """Actor body: owns one job's entrypoint subprocess (reference:
     job_supervisor.py). Runs on any node; the entrypoint gets
@@ -126,13 +137,7 @@ class JobSubmissionClient:
         return json.loads(raw)
 
     def list_jobs(self) -> List[Dict[str, Any]]:
-        keys = self._rt._gcs.call("kv_keys", _JOB_PREFIX)
-        out = []
-        for k in keys:
-            raw = self._rt._gcs.call("kv_get", k)
-            if raw:
-                out.append(json.loads(raw))
-        return sorted(out, key=lambda r: r.get("ts", 0))
+        return list_job_records(self._rt._gcs)
 
     def get_job_logs(self, job_id: str) -> str:
         session_dir = getattr(self._rt, "_session_dir", None) or os.path.dirname(

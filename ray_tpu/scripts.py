@@ -162,6 +162,19 @@ def cmd_logs(args) -> None:
     sys.stdout.write(JobSubmissionClient().get_job_logs(args.job_id))
 
 
+def cmd_dashboard(args) -> None:
+    _connect(args)
+    from .dashboard import start_dashboard
+
+    port = start_dashboard(port=args.port)
+    print(f"dashboard at http://127.0.0.1:{port}/ (ctrl-c to stop)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="ray-tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -196,6 +209,11 @@ def main(argv=None) -> None:
     p.add_argument("--address", default=None)
     p.add_argument("job_id")
     p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("dashboard", help="serve the cluster dashboard")
+    p.add_argument("--address", default=None)
+    p.add_argument("--port", type=int, default=8265)
+    p.set_defaults(fn=cmd_dashboard)
 
     args = ap.parse_args(argv)
     args.fn(args)

@@ -103,3 +103,44 @@ def test_list_nodes_and_objects(rt_cluster):
         )
     )
     del ref
+
+
+def test_log_to_driver_streams_worker_prints(rt_cluster, capfd):
+    @rt.remote
+    def noisy():
+        print("stream-me-to-driver", flush=True)
+        return 1
+
+    assert rt.get(noisy.remote(), timeout=60) == 1
+    # capfd drains incrementally; poll the combined output.
+    deadline = time.monotonic() + 10
+    seen = ""
+    while time.monotonic() < deadline and "stream-me-to-driver" not in seen:
+        seen += capfd.readouterr().out
+        time.sleep(0.3)
+    assert "stream-me-to-driver" in seen
+
+
+def test_dashboard_endpoints(rt_cluster):
+    import json
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @rt.remote
+    def f():
+        return 1
+
+    rt.get(f.remote(), timeout=60)
+    port = start_dashboard(port=0)
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/api/stats", timeout=10) as r:
+            stats = json.loads(r.read())
+        assert stats["nodes_alive"] >= 1
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/api/nodes", timeout=10) as r:
+            nodes = json.loads(r.read())
+        assert len(nodes) >= 1
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=10) as r:
+            assert b"ray_tpu cluster" in r.read()
+    finally:
+        stop_dashboard()
