@@ -17,6 +17,7 @@ from .module import (
     logp_entropy,
     sample_actions,
 )
+from .offline import BC, BCConfig, bc_loss, rollouts_to_dataset
 from .multi_agent import (
     MultiAgentEnv,
     MultiAgentEnvRunner,
@@ -34,5 +35,6 @@ __all__ = [
     "logp_entropy", "sample_actions", "PPO", "PPOConfig", "compute_gae",
     "ppo_loss", "DQN", "DQNConfig", "QModule", "dqn_loss",
     "TransitionReplayBuffer", "MultiAgentEnv", "MultiAgentEnvRunner",
-    "MultiAgentPPO", "MultiAgentPPOConfig",
+    "MultiAgentPPO", "MultiAgentPPOConfig", "BC", "BCConfig", "bc_loss",
+    "rollouts_to_dataset",
 ]

@@ -307,6 +307,33 @@ class ProxyASGIApp:
         await send({"type": "http.response.body", "body": data, "more_body": False})
 
 
+class HandleCache:
+    """Thread-safe app -> DeploymentHandle cache shared by the HTTP and
+    gRPC proxies (one handle per app keeps pow-2 outstanding counters
+    accurate)."""
+
+    def __init__(self):
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._lock = threading.Lock()
+
+    def get(self, app: str) -> DeploymentHandle:
+        with self._lock:
+            cached = self._handles.get(app)
+        if cached is not None:
+            return cached
+        controller = api.get_actor(CONTROLLER_NAME)
+        apps = api.get(controller.list_apps.remote())
+        name = app
+        if app not in apps:
+            if app == "" and len(apps) == 1:
+                name = apps[0]
+            else:
+                raise KeyError(f"no app {app!r}; deployed: {apps}")
+        handle = DeploymentHandle(name)
+        with self._lock:
+            return self._handles.setdefault(app, handle)
+
+
 class _ProxyServer:
     """Hosts ProxyASGIApp on a threaded stdlib HTTP server through a
     minimal ASGI adapter (chunked transfer for multi-part bodies). In a
@@ -389,24 +416,14 @@ class _ProxyServer:
             allow_reuse_address = True
             daemon_threads = True
 
-        self._handles: Dict[str, DeploymentHandle] = {}
+        self._handle_cache = HandleCache()
         self._server = Server((host, port), Handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         self._thread.start()
 
     def _handle_for(self, app: str) -> DeploymentHandle:
-        if app not in self._handles:
-            controller = api.get_actor(CONTROLLER_NAME)
-            apps = api.get(controller.list_apps.remote())
-            if app not in apps:
-                if app == "" and len(apps) == 1:
-                    app_real = apps[0]
-                    self._handles[""] = DeploymentHandle(app_real)
-                    return self._handles[""]
-                raise KeyError(app)
-            self._handles[app] = DeploymentHandle(app)
-        return self._handles[app]
+        return self._handle_cache.get(app)
 
     def shutdown(self):
         self._server.shutdown()

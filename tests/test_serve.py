@@ -243,3 +243,40 @@ def test_async_generator_streaming(rt):
 
     handle = serve.run(AsyncStreamer.bind(), name="astream", http_port=None)
     assert list(handle.options(stream=True).remote(3)) == ["a0", "a1", "a2"]
+
+
+def test_grpc_proxy_unary_and_streaming(rt):
+    """gRPC ingress e2e (reference: serve gRPC proxy, proxy.py gRPCProxy):
+    generic bytes service, method path = /<app>/<method>."""
+    import grpc
+
+    from ray_tpu import serve
+    from ray_tpu.serve.grpc_proxy import start_grpc_proxy, stop_grpc_proxy
+
+    @serve.deployment
+    class Svc:
+        def __call__(self, payload):
+            return {"got": payload}
+
+        def stream_tokens(self, n):
+            for i in range(n):
+                yield f"tok{i}"
+
+    serve.run(Svc.bind(), name="svc", http_port=None)
+    port = start_grpc_proxy(port=0)
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        unary = channel.unary_unary(
+            "/svc/__call__", request_serializer=bytes, response_deserializer=bytes
+        )
+        out = json.loads(unary(json.dumps({"k": 1}).encode(), timeout=60))
+        assert out == {"got": {"k": 1}}
+
+        stream = channel.unary_stream(
+            "/svc/stream_tokens", request_serializer=bytes, response_deserializer=bytes
+        )
+        chunks = [c.decode() for c in stream(b"3", timeout=60)]
+        assert chunks == ["tok0", "tok1", "tok2"]
+        channel.close()
+    finally:
+        stop_grpc_proxy()
