@@ -655,6 +655,11 @@ class GcsService:
                         rec["reason"] = evt["reason"]
                     if evt.get("retry"):
                         rec["retries"] = evt["retry"]
+                    # Bounded transition history: feeds the timeline export
+                    # (reference: task events backing `ray timeline`).
+                    hist = rec.setdefault("history", [])
+                    hist.append((evt["state"], rec["ts"], node_id))
+                    del hist[:-8]
         if stale and node_sock:
             try:
                 self._raylet_call(node_sock, "delete_objects", stale)
@@ -662,13 +667,26 @@ class GcsService:
                 pass
         return True
 
+    @staticmethod
+    def _task_copy(rec: dict) -> dict:
+        # History is the one nested MUTABLE value: deep-copy it under the
+        # lock or the RPC layer pickles it while node_sync appends.
+        out = dict(rec)
+        if "history" in out:
+            out["history"] = list(out["history"])
+        return out
+
     def get_task_states(self, task_ids: List[str]) -> Dict[str, dict]:
         with self._lock:
-            return {tid: dict(self._tasks[tid]) for tid in task_ids if tid in self._tasks}
+            return {
+                tid: self._task_copy(self._tasks[tid])
+                for tid in task_ids
+                if tid in self._tasks
+            }
 
     def list_tasks(self, limit: int = 1000) -> List[dict]:
         with self._lock:
-            out = [dict(rec) for rec in self._tasks.values()]
+            out = [self._task_copy(rec) for rec in self._tasks.values()]
         return out[-limit:]
 
     # --------------------------------------------------------------- kv

@@ -17,6 +17,13 @@ from .module import (
     logp_entropy,
     sample_actions,
 )
+from .connectors import (
+    ClipObs,
+    Connector,
+    ConnectorPipeline,
+    FlattenObs,
+    NormalizeObs,
+)
 from .offline import BC, BCConfig, bc_loss, rollouts_to_dataset
 from .multi_agent import (
     MultiAgentEnv,
@@ -36,5 +43,6 @@ __all__ = [
     "ppo_loss", "DQN", "DQNConfig", "QModule", "dqn_loss",
     "TransitionReplayBuffer", "MultiAgentEnv", "MultiAgentEnvRunner",
     "MultiAgentPPO", "MultiAgentPPOConfig", "BC", "BCConfig", "bc_loss",
-    "rollouts_to_dataset",
+    "rollouts_to_dataset", "Connector", "ConnectorPipeline", "FlattenObs",
+    "ClipObs", "NormalizeObs",
 ]

@@ -1,0 +1,102 @@
+"""Connectors: observation pipelines between env and module.
+
+Re-design of the reference's ConnectorV2 (reference:
+rllib/connectors/connector_v2.py:31 — env-to-module pipelines composed of
+small stateful pieces). A connector maps raw env observations to module
+inputs; pipelines compose left to right. Stateful connectors (running
+normalization) update during sampling; the transformed observations are
+what the rollout buffer stores, so training sees exactly what the policy
+saw.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class Connector:
+    """One env-to-module transform (reference: connector_v2.py:31)."""
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def get_state(self) -> Dict[str, Any]:
+        return {}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        pass
+
+
+class FlattenObs(Connector):
+    """[B, ...] -> [B, prod(...)] (the default MLP input adapter)."""
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        obs = np.asarray(obs, np.float32)
+        return obs.reshape(obs.shape[0], -1)
+
+
+class ClipObs(Connector):
+    def __init__(self, low: float = -10.0, high: float = 10.0):
+        self.low, self.high = low, high
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        return np.clip(obs, self.low, self.high)
+
+
+class NormalizeObs(Connector):
+    """Running mean/std normalization (reference: the MeanStdFilter
+    connector). Stats update during sampling; freeze() for evaluation."""
+
+    def __init__(self, eps: float = 1e-8):
+        self.eps = eps
+        self.count = 0.0
+        self.mean: Optional[np.ndarray] = None
+        self.m2: Optional[np.ndarray] = None
+        self.frozen = False
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        obs = np.asarray(obs, np.float32)
+        if self.mean is None:
+            self.mean = np.zeros(obs.shape[1:], np.float64)
+            self.m2 = np.ones(obs.shape[1:], np.float64)
+        if not self.frozen:
+            for row in obs:  # Welford over the batch
+                self.count += 1.0
+                delta = row - self.mean
+                self.mean += delta / self.count
+                self.m2 += delta * (row - self.mean)
+        var = self.m2 / max(1.0, self.count)
+        return ((obs - self.mean) / np.sqrt(var + self.eps)).astype(np.float32)
+
+    def freeze(self) -> None:
+        self.frozen = True
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"count": self.count, "mean": self.mean, "m2": self.m2}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.count = state["count"]
+        self.mean = state["mean"]
+        self.m2 = state["m2"]
+
+
+class ConnectorPipeline(Connector):
+    """Left-to-right composition (reference: ConnectorPipelineV2)."""
+
+    def __init__(self, connectors: List[Connector]):
+        self.connectors = list(connectors)
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        for c in self.connectors:
+            obs = c(obs)
+        return obs
+
+    def get_state(self) -> Dict[str, Any]:
+        return {i: c.get_state() for i, c in enumerate(self.connectors)}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        for i, c in enumerate(self.connectors):
+            if i in state:
+                c.set_state(state[i])

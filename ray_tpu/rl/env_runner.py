@@ -22,7 +22,14 @@ from .module import RLModule
 class SingleAgentEnvRunner:
     """One sampling worker (reference: single_agent_env_runner.py:64)."""
 
-    def __init__(self, env_name: str, module_blob: bytes, num_envs: int, seed: int = 0):
+    def __init__(
+        self,
+        env_name: str,
+        module_blob: bytes,
+        num_envs: int,
+        seed: int = 0,
+        connector_blob: bytes = b"",
+    ):
         import cloudpickle
         import gymnasium as gym
         import jax
@@ -30,6 +37,10 @@ class SingleAgentEnvRunner:
         self._jax = jax
         self.envs = gym.make_vec(env_name, num_envs=num_envs)
         self.module: RLModule = cloudpickle.loads(module_blob)
+        # env-to-module connector pipeline (reference: connector_v2.py):
+        # applied to every observation; the buffer stores the TRANSFORMED
+        # obs so training sees what the policy saw.
+        self.connector = cloudpickle.loads(connector_blob) if connector_blob else None
         self.num_envs = num_envs
         self._key = jax.random.PRNGKey(seed)
         self._params = None
@@ -47,9 +58,11 @@ class SingleAgentEnvRunner:
         # exploration state (e.g. epsilon) can ride the weight sync.
         self._sample = jax.jit(lambda params, key, out: self.module.sample_with_params(params, key, out))
 
-    @staticmethod
-    def _flatten(obs: np.ndarray) -> np.ndarray:
-        """Multi-dim observations flatten to the MLP's input layout."""
+    def _flatten(self, obs: np.ndarray) -> np.ndarray:
+        """Default env-to-module transform: flatten to the MLP layout; a
+        configured connector pipeline replaces it."""
+        if self.connector is not None:
+            return np.asarray(self.connector(np.asarray(obs)), np.float32)
         return np.asarray(obs, np.float32).reshape(obs.shape[0], -1)
 
     def set_weights(self, params) -> bool:
@@ -138,6 +151,14 @@ class SingleAgentEnvRunner:
             self._completed_returns = []
         return out
 
+    def get_connector_state(self):
+        return self.connector.get_state() if self.connector is not None else None
+
+    def set_connector_state(self, state) -> bool:
+        if self.connector is not None and state is not None:
+            self.connector.set_state(state)
+        return True
+
     def ping(self) -> bool:
         return True
 
@@ -155,15 +176,18 @@ class EnvRunnerGroup:
         num_runners: int = 2,
         num_envs_per_runner: int = 4,
         seed: int = 0,
+        connector=None,
     ):
         import cloudpickle
 
         self._env_name = env_name
         self._module_blob = cloudpickle.dumps(module)
+        self._connector_blob = cloudpickle.dumps(connector) if connector else b""
         self._num_envs = num_envs_per_runner
         self._seed = seed
         self._restarts = 0
         self._last_weights_ref = None  # re-seeds replacement runners
+        self._last_connector_state = None
         self._cls = api.remote(max_concurrency=1)(SingleAgentEnvRunner)
         self._runners = [
             self._make_runner(i) for i in range(num_runners)
@@ -171,10 +195,19 @@ class EnvRunnerGroup:
 
     def _make_runner(self, idx: int):
         runner = self._cls.remote(
-            self._env_name, self._module_blob, self._num_envs, self._seed + 1000 * idx
+            self._env_name,
+            self._module_blob,
+            self._num_envs,
+            self._seed + 1000 * idx,
+            self._connector_blob,
         )
         if self._last_weights_ref is not None:
             api.get(runner.set_weights.remote(self._last_weights_ref))
+        if self._last_connector_state is not None:
+            # A replacement runner must not restart stateful connectors
+            # (e.g. obs normalization) from zero: its observations would
+            # arrive at a different scale than the policy was trained on.
+            api.get(runner.set_connector_state.remote(self._last_connector_state))
         return runner
 
     def replace_runner(self, runner) -> Any:
@@ -209,15 +242,31 @@ class EnvRunnerGroup:
     def sample(self, num_steps_per_runner: int) -> List[Dict[str, np.ndarray]]:
         refs = [r.sample.remote(num_steps_per_runner) for r in self._runners]
         out = []
+        first_alive = None
         for i, ref in enumerate(refs):
             try:
                 out.append(api.get(ref))
+                if first_alive is None:
+                    first_alive = self._runners[i]
             except Exception:
                 # Probe-and-restart (reference: actor_manager.py:641):
                 # replace the dead runner; its sample is skipped this round.
                 self._restarts += 1
                 self._runners[i] = self._make_runner(i)
+        if self._connector_blob and first_alive is not None:
+            # Cache mature connector stats for replacements + checkpoints.
+            try:
+                self._last_connector_state = api.get(
+                    first_alive.get_connector_state.remote()
+                )
+            except Exception:
+                pass
         return out
+
+    def connector_state(self):
+        """Latest stateful-connector state (for checkpoints / evaluation
+        parity with the sampling-time observation transform)."""
+        return self._last_connector_state
 
     def episode_returns(self) -> List[float]:
         outs = api.get([r.episode_returns.remote() for r in self._runners])

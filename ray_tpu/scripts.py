@@ -162,6 +162,14 @@ def cmd_logs(args) -> None:
     sys.stdout.write(JobSubmissionClient().get_job_logs(args.job_id))
 
 
+def cmd_timeline(args) -> None:
+    _connect(args)
+    from .utils import state
+
+    events = state.timeline(args.out)
+    print(f"wrote {len(events)} task spans to {args.out} (open in Perfetto)")
+
+
 def cmd_dashboard(args) -> None:
     _connect(args)
     from .dashboard import start_dashboard
@@ -214,6 +222,11 @@ def main(argv=None) -> None:
     p.add_argument("--address", default=None)
     p.add_argument("--port", type=int, default=8265)
     p.set_defaults(fn=cmd_dashboard)
+
+    p = sub.add_parser("timeline", help="export a chrome-trace of task spans")
+    p.add_argument("--address", default=None)
+    p.add_argument("--out", default="ray_tpu_timeline.json")
+    p.set_defaults(fn=cmd_timeline)
 
     args = ap.parse_args(argv)
     args.fn(args)

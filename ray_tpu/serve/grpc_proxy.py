@@ -56,9 +56,10 @@ class _GrpcProxy:
                 context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
             context.abort(grpc.StatusCode.INTERNAL, repr(e))
 
-        def _deadline(context) -> float:
-            remaining = context.time_remaining()
-            return min(remaining, 600.0) if remaining is not None else 60.0
+        def _deadline(context) -> Optional[float]:
+            # gRPC semantics: no client deadline means wait indefinitely;
+            # an explicit deadline is honored as-is.
+            return context.time_remaining()
 
         class Handler(grpc.GenericRpcHandler):
             def service(self, call_details):
@@ -112,6 +113,8 @@ class _GrpcProxy:
         )
         self._server.add_generic_rpc_handlers((Handler(),))
         self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if self.port == 0:
+            raise RuntimeError(f"gRPC proxy failed to bind {host}:{port} (in use?)")
         self._server.start()
 
     def _handle_for(self, app: str) -> DeploymentHandle:

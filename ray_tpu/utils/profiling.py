@@ -1,0 +1,60 @@
+"""Profiling hooks: XLA device traces + wall-time spans.
+
+The reference profiles with OpenTelemetry spans + py-spy dumps
+(reference: python/ray/_private/profiling.py, util/state `ray timeline`).
+The TPU-native counterpart is the jax profiler: `device_trace` captures an
+XLA trace (TensorBoard / Perfetto-loadable) of everything the wrapped
+block compiles and runs — the tool that actually explains TPU step time.
+Task-level wall spans come from `ray_tpu.utils.state.timeline()`.
+
+    from ray_tpu.utils.profiling import device_trace, span
+    with device_trace("/tmp/tb"):        # XLA ops, HBM, ICI collectives
+        train_step(...)
+    with span("preprocess"):             # wall-clock span -> log line
+        ...
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
+    """Captures a jax/XLA profiler trace into `logdir` (view with
+    TensorBoard's profile plugin or Perfetto)."""
+    import jax
+
+    jax.profiler.start_trace(logdir, create_perfetto_trace=False)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def span(name: str, *, annotate_device: bool = True) -> Iterator[None]:
+    """A named wall-clock span, also annotated onto the device trace when
+    one is active (jax.profiler.TraceAnnotation)."""
+    import jax
+
+    t0 = time.perf_counter()
+    ctx = (
+        jax.profiler.TraceAnnotation(name)
+        if annotate_device
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        yield
+    dt = time.perf_counter() - t0
+    print(f"[span] {name}: {dt * 1e3:.2f} ms", flush=True)
+
+
+def save_device_memory_profile(path: str) -> None:
+    """Dumps the current device memory profile (pprof format; reference
+    analogue: ray memory / heap profiling)."""
+    import jax
+
+    jax.profiler.save_device_memory_profile(path)

@@ -61,6 +61,41 @@ def get_task(task_id: str) -> Optional[Dict[str, Any]]:
     return _gcs().call("get_task_states", [task_id]).get(task_id)
 
 
+def timeline(path: Optional[str] = None) -> Any:
+    """Chrome-trace (Perfetto/chrome://tracing) export of task execution
+    spans (reference: `ray timeline`, python/ray/_private/state.py
+    chrome_tracing_dump). Returns the event list; writes JSON when `path`
+    is given."""
+    import json
+
+    events = []
+    for rec in list_tasks(limit=100_000):
+        hist = rec.get("history") or []
+        start = None
+        for st, ts, node in hist:
+            if st == "RUNNING":
+                start = (ts, node)
+            elif st in ("FINISHED", "FAILED") and start is not None:
+                t0, node0 = start
+                events.append(
+                    {
+                        "name": rec.get("name") or rec["task_id"][:8],
+                        "cat": "task",
+                        "ph": "X",
+                        "ts": t0 * 1e6,
+                        "dur": max(0.0, (ts - t0) * 1e6),
+                        "pid": f"node:{node0[:8]}",
+                        "tid": rec["task_id"][:8],
+                        "args": {"state": st, "task_id": rec["task_id"]},
+                    }
+                )
+                start = None
+    if path:
+        with open(path, "w") as f:
+            json.dump(events, f)
+    return events
+
+
 def log_dir() -> Optional[str]:
     """The session's log directory (gcs/raylet/worker stdout+stderr)."""
     rt = runtime_base.current_runtime()

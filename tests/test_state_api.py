@@ -144,3 +144,22 @@ def test_dashboard_endpoints(rt_cluster):
             assert b"ray_tpu cluster" in r.read()
     finally:
         stop_dashboard()
+
+
+def test_timeline_export(rt_cluster, tmp_path):
+    @rt.remote
+    def work():
+        time.sleep(0.2)
+        return 1
+
+    rt.get([work.remote() for _ in range(3)], timeout=60)
+    out = str(tmp_path / "trace.json")
+    assert _wait_for(lambda: len(state.timeline()) >= 3)
+    events = state.timeline(out)
+    assert len(events) >= 3
+    ev = next(e for e in events if e["cat"] == "task")
+    assert ev["ph"] == "X" and ev["dur"] > 0
+    import json as _json
+
+    with open(out) as f:
+        assert len(_json.load(f)) == len(events)
