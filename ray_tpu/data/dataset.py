@@ -127,15 +127,43 @@ class ExecStats:
     wall_s: float = 0.0
 
 
-def _windowed(refs: Iterator[Any], window: int) -> Iterator[Any]:
+def _block_nbytes(ref) -> Optional[int]:
+    """Size of a locally-present block's framed payload (None if remote or
+    still in flight) — the cheap signal the byte budget adapts on."""
+    from ..core import runtime_base
+
+    rt = runtime_base.maybe_runtime()
+    store = getattr(rt, "_store", None)
+    if store is None or not hasattr(ref, "id"):
+        return None
+    try:
+        return store.raw_size(ref.id())
+    except Exception:
+        return None
+
+
+def _windowed(
+    refs: Iterator[Any], window: int, memory_budget: Optional[int] = None
+) -> Iterator[Any]:
     """Lookahead buffer: pulls (and thereby submits) up to `window` refs
-    ahead of the consumer — bounded in-flight work with read/compute overlap."""
+    ahead of the consumer — bounded in-flight work with read/compute
+    overlap. With a `memory_budget` (bytes), the effective window shrinks
+    to budget/observed-block-size (reference: streaming executor resource
+    budgets, streaming_executor_state.py — the memory half)."""
     from collections import deque
 
     buf: "deque" = deque()
+    est_size: Optional[int] = None
     for r in refs:
         buf.append(r)
-        if len(buf) > window:
+        eff = window
+        if memory_budget:
+            size = _block_nbytes(buf[0])
+            if size:
+                est_size = size
+            if est_size:
+                eff = max(1, min(window, memory_budget // max(1, est_size)))
+        if len(buf) > eff:
             yield buf.popleft()
     while buf:
         yield buf.popleft()
@@ -199,10 +227,26 @@ class Dataset:
         return self._extended(_Op(kind="limit", n=n))
 
     # ---------------------------------------------------------- execution
+    @staticmethod
+    def _optimize(ops: List[_Op]) -> List[_Op]:
+        """Logical plan rules (reference: the rule-based optimizer,
+        data/_internal/logical/rules/ — operator fusion lives in
+        _plan_stages; here: LIMIT PUSHDOWN past row-count-preserving maps,
+        so `ds.map(f).limit(n)` transforms only n rows)."""
+        ops = list(ops)
+        changed = True
+        while changed:
+            changed = False
+            for i in _range(2, len(ops)):  # ops[0] is the source
+                if ops[i].kind == "limit" and ops[i - 1].kind == "map_rows":
+                    ops[i - 1], ops[i] = ops[i], ops[i - 1]
+                    changed = True
+        return ops
+
     def _plan_stages(self):
         """Splits ops into (source, [stage...]) where each stage is either
         a fused chain, an actor-pool map, or a barrier op."""
-        ops = self._ops
+        ops = self._optimize(self._ops)
         source = ops[0]
         assert source.kind in ("read", "input")
         stages: List[Any] = []
@@ -235,11 +279,14 @@ class Dataset:
         for t in tasks:
             yield do_read.remote(t)
 
-    def iter_block_refs(self, prefetch: int = 8) -> Iterator[Any]:
+    def iter_block_refs(
+        self, prefetch: int = 8, memory_budget: Optional[int] = None
+    ) -> Iterator[Any]:
         """The streaming executor: yields refs to output blocks, keeping at
         most `prefetch` block-task chains in flight (the pull window IS the
-        backpressure budget). Barrier stages (repartition/shuffle/sort)
-        materialize their input before streaming resumes."""
+        backpressure budget; `memory_budget` bytes additionally shrinks the
+        window to budget/block-size). Barrier stages (repartition/shuffle/
+        sort) materialize their input before streaming resumes."""
         import time as _time
 
         _ensure_initialized()
@@ -264,7 +311,7 @@ class Dataset:
                 raise ValueError(f"unknown stage {kind}")
 
         n = 0
-        for ref in _windowed(refs, max(1, prefetch)):
+        for ref in _windowed(refs, max(1, prefetch), memory_budget):
             n += 1
             yield ref
         self.stats.num_blocks = n

@@ -61,12 +61,17 @@ class NormalizeObs(Connector):
         if self.mean is None:
             self.mean = np.zeros(obs.shape[1:], np.float64)
             self.m2 = np.ones(obs.shape[1:], np.float64)
-        if not self.frozen:
-            for row in obs:  # Welford over the batch
-                self.count += 1.0
-                delta = row - self.mean
-                self.mean += delta / self.count
-                self.m2 += delta * (row - self.mean)
+        if not self.frozen and len(obs):
+            # Batched (Chan) Welford merge: O(1) vectorized ops per batch
+            # instead of a per-row Python loop on the sampling hot path.
+            b = float(len(obs))
+            b_mean = obs.mean(axis=0, dtype=np.float64)
+            b_m2 = ((obs - b_mean) ** 2).sum(axis=0, dtype=np.float64)
+            delta = b_mean - self.mean
+            total = self.count + b
+            self.mean += delta * (b / total)
+            self.m2 += b_m2 + delta**2 * (self.count * b / total)
+            self.count = total
         var = self.m2 / max(1.0, self.count)
         return ((obs - self.mean) / np.sqrt(var + self.eps)).astype(np.float32)
 

@@ -254,11 +254,18 @@ class EnvRunnerGroup:
                 self._restarts += 1
                 self._runners[i] = self._make_runner(i)
         if self._connector_blob and first_alive is not None:
-            # Cache mature connector stats for replacements + checkpoints.
+            # Cache mature connector stats for replacements + checkpoints,
+            # and broadcast them so stateful connectors do not drift apart
+            # across runners (identical raw obs must normalize identically
+            # within a training batch).
             try:
                 self._last_connector_state = api.get(
                     first_alive.get_connector_state.remote()
                 )
+                if self._last_connector_state is not None:
+                    for r in self._runners:
+                        if r is not first_alive:
+                            r.set_connector_state.remote(self._last_connector_state)
             except Exception:
                 pass
         return out

@@ -56,10 +56,12 @@ class _GrpcProxy:
                 context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
             context.abort(grpc.StatusCode.INTERNAL, repr(e))
 
-        def _deadline(context) -> Optional[float]:
-            # gRPC semantics: no client deadline means wait indefinitely;
-            # an explicit deadline is honored as-is.
-            return context.time_remaining()
+        def _deadline(context) -> float:
+            # Explicit client deadlines are honored as-is; deadline-less
+            # calls get a server-side bound so a hung replica cannot pin
+            # proxy worker threads forever (pool is finite).
+            remaining = context.time_remaining()
+            return remaining if remaining is not None else 300.0
 
         class Handler(grpc.GenericRpcHandler):
             def service(self, call_details):

@@ -22,7 +22,7 @@ from typing import Iterator, Optional
 
 
 @contextlib.contextmanager
-def device_trace(logdir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
+def device_trace(logdir: str) -> Iterator[None]:
     """Captures a jax/XLA profiler trace into `logdir` (view with
     TensorBoard's profile plugin or Perfetto)."""
     import jax

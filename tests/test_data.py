@@ -167,3 +167,52 @@ def test_limit_streams_lazily(rt):
     # With 10-row source blocks and a prefetch window of 8, far fewer than
     # 1000 rows may be touched.
     assert len(executed) <= 200
+
+
+# --------------------------------------------------------------- round 3
+def test_limit_pushdown_skips_map_work(rt):
+    """Optimizer rule: ds.map(f).limit(n) maps only the limited rows."""
+    from ray_tpu import data as rd
+
+    calls = []
+
+    def spy(row):
+        calls.append(row)
+        return row * 10
+
+    ds = rd.from_items(list(range(100)), parallelism=10).map(spy).limit(5)
+    out = ds.take_all()
+    assert out == [0, 10, 20, 30, 40]
+    # Pushdown: only the first block's surviving rows are mapped (the spy
+    # runs inside tasks; local_mode shares the list). Without pushdown all
+    # 100 rows would be transformed.
+    assert len(calls) <= 10, f"map ran on {len(calls)} rows despite limit(5)"
+
+
+def test_plan_optimizer_reorders_limit():
+    from ray_tpu.data.dataset import Dataset, _Op
+
+    ops = [
+        _Op(kind="input", blocks=[]),
+        _Op(kind="map_rows", fn=lambda r: r),
+        _Op(kind="map_rows", fn=lambda r: r),
+        _Op(kind="limit", n=3),
+    ]
+    optimized = Dataset._optimize(ops)
+    assert [o.kind for o in optimized] == ["input", "limit", "map_rows", "map_rows"]
+    # filter blocks the pushdown (it changes row counts)
+    ops2 = [
+        _Op(kind="input", blocks=[]),
+        _Op(kind="filter", fn=lambda r: True),
+        _Op(kind="limit", n=3),
+    ]
+    assert [o.kind for o in Dataset._optimize(ops2)] == ["input", "filter", "limit"]
+
+
+def test_memory_budget_bounds_window(rt):
+    from ray_tpu import data as rd
+
+    ds = rd.from_items(list(range(1000)), parallelism=20).map(lambda r: r + 1)
+    # A tiny byte budget must still stream every block correctly.
+    refs = list(ds.iter_block_refs(prefetch=8, memory_budget=1))
+    assert len(refs) == 20
