@@ -33,6 +33,7 @@ from .multi_agent import (
 )
 from .ppo import PPO, PPOConfig, compute_gae, ppo_loss
 from .replay import TransitionReplayBuffer
+from .sac import SAC, SACConfig, SquashedGaussianModule
 
 __all__ = [
     "EnvRunnerGroup", "SingleAgentEnvRunner", "IMPALA", "IMPALAConfig",
@@ -44,5 +45,5 @@ __all__ = [
     "TransitionReplayBuffer", "MultiAgentEnv", "MultiAgentEnvRunner",
     "MultiAgentPPO", "MultiAgentPPOConfig", "BC", "BCConfig", "bc_loss",
     "rollouts_to_dataset", "Connector", "ConnectorPipeline", "FlattenObs",
-    "ClipObs", "NormalizeObs",
+    "ClipObs", "NormalizeObs", "SAC", "SACConfig", "SquashedGaussianModule",
 ]

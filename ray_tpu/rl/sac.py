@@ -1,0 +1,319 @@
+"""SAC: soft actor-critic for continuous control.
+
+Re-design of the reference's SAC (reference: rllib/algorithms/sac/sac.py;
+loss rllib/algorithms/sac/torch/sac_torch_learner.py — squashed-Gaussian
+policy, twin Q networks, polyak target smoothing, learned entropy
+temperature). The whole update (actor + twin critics + alpha + target
+polyak) is ONE jitted function over a params pytree — no per-network
+module wrappers or DDP hooks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .env_runner import EnvRunnerGroup
+from .module import DiscretePolicyConfig, DiscretePolicyModule, RLModule
+from .replay import TransitionReplayBuffer
+
+PyTree = Any
+_LOG_STD_MIN, _LOG_STD_MAX = -20.0, 2.0
+
+
+class SquashedGaussianModule(RLModule):
+    """tanh-squashed Gaussian policy with state-dependent std plus twin Q
+    critics (reference: sac_catalog building pi/q networks)."""
+
+    action_kind = "continuous"
+
+    def __init__(self, obs_dim: int, act_dim: int, hidden=(256, 256), low=-1.0, high=1.0):
+        self.obs_dim, self.act_dim, self.hidden = obs_dim, act_dim, tuple(hidden)
+        low = np.broadcast_to(np.asarray(low, np.float32), (act_dim,))
+        high = np.broadcast_to(np.asarray(high, np.float32), (act_dim,))
+        self.scale = (high - low) / 2.0
+        self.center = (high + low) / 2.0
+        self.action_shape = (act_dim,)
+        self._helper = DiscretePolicyModule(
+            DiscretePolicyConfig(obs_dim=obs_dim, n_actions=act_dim, hidden=self.hidden)
+        )
+
+    # ---- params ----
+    def init_params(self, key: jax.Array) -> PyTree:
+        kp, k1, k2 = jax.random.split(key, 3)
+        mk = self._helper._mlp_params
+        qdims = (self.obs_dim + self.act_dim,) + self.hidden + (1,)
+        return {
+            "pi": mk(kp, (self.obs_dim,) + self.hidden + (2 * self.act_dim,)),
+            "q1": mk(k1, qdims),
+            "q2": mk(k2, qdims),
+            "log_alpha": jnp.asarray(0.0, jnp.float32),
+        }
+
+    # ---- policy ----
+    def _pi(self, params, obs):
+        out = DiscretePolicyModule._mlp(params["pi"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, _LOG_STD_MIN, _LOG_STD_MAX)
+        return mean, log_std
+
+    def pi_sample(self, params, key, obs):
+        """Reparameterized squashed sample + logp (tanh correction)."""
+        mean, log_std = self._pi(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mean.shape, mean.dtype)
+        pre = mean + std * eps
+        logp = jnp.sum(
+            -0.5 * eps**2 - log_std - 0.5 * math.log(2 * math.pi), axis=-1
+        )
+        # tanh change of variables (the numerically stable softplus form),
+        # plus the affine rescale term: act = tanh(pre)*scale + center, so
+        # without -sum(log scale) the density (and therefore the entropy
+        # the temperature tunes toward) is biased on non-unit bounds.
+        logp -= jnp.sum(2.0 * (math.log(2.0) - pre - jax.nn.softplus(-2.0 * pre)), axis=-1)
+        logp -= jnp.sum(jnp.log(self.scale))
+        act = jnp.tanh(pre) * self.scale + self.center
+        return act, logp
+
+    def q_value(self, qparams, obs, act):
+        x = jnp.concatenate([obs, act], axis=-1)
+        return DiscretePolicyModule._mlp(qparams, x)[..., 0]
+
+    # ---- RLModule surface (env runner integration) ----
+    def forward_inference(self, params, obs):
+        mean, log_std = self._pi(params, obs)
+        return {"mean": mean, "log_std": log_std}
+
+    def sample_with_params(self, params, key, fwd_out):
+        mean, log_std = fwd_out["mean"], fwd_out["log_std"]
+        std = jnp.exp(log_std)
+        pre = mean + std * jax.random.normal(key, mean.shape, mean.dtype)
+        act = jnp.tanh(pre) * self.scale + self.center
+        return act, jnp.zeros_like(act[..., 0])  # logp unused off-policy
+
+
+@dataclasses.dataclass
+class SACConfig:
+    """(reference: sac.py SACConfig)"""
+
+    env: str = "Pendulum-v1"
+    num_env_runners: int = 1
+    num_envs_per_runner: int = 8
+    rollout_length: int = 16
+    buffer_capacity: int = 100_000
+    learning_starts: int = 1_000
+    train_batch_size: int = 256
+    updates_per_iteration: int = 32
+    gamma: float = 0.99
+    tau: float = 0.005                 # polyak target smoothing
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    target_entropy: Optional[float] = None  # default: -act_dim
+    hidden: Tuple[int, ...] = (256, 256)
+    seed: int = 0
+
+    def environment(self, env: str) -> "SACConfig":
+        self.env = env
+        return self
+
+    def training(self, **kw) -> "SACConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise AttributeError(k)
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    """(reference: Algorithm + SAC.training_step)"""
+
+    def __init__(self, config: SACConfig):
+        import gymnasium as gym
+        import optax
+
+        self.config = config
+        probe = gym.make(config.env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        act_dim = int(np.prod(probe.action_space.shape))
+        low, high = probe.action_space.low, probe.action_space.high
+        probe.close()
+        self.module = SquashedGaussianModule(
+            obs_dim, act_dim, hidden=config.hidden, low=low, high=high
+        )
+        self.target_entropy = (
+            config.target_entropy if config.target_entropy is not None else -float(act_dim)
+        )
+        key = jax.random.PRNGKey(config.seed)
+        self.params = self.module.init_params(key)
+        self.target_q = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self._tx = {
+            "pi": optax.adam(config.actor_lr),
+            "q": optax.adam(config.critic_lr),
+            "alpha": optax.adam(config.alpha_lr),
+        }
+        self._opt = {
+            "pi": self._tx["pi"].init(self.params["pi"]),
+            "q": self._tx["q"].init({"q1": self.params["q1"], "q2": self.params["q2"]}),
+            "alpha": self._tx["alpha"].init(self.params["log_alpha"]),
+        }
+        self._update = jax.jit(self._update_impl)
+        self._key = jax.random.PRNGKey(config.seed + 1)
+
+        self.env_runner_group = EnvRunnerGroup(
+            config.env, self.module,
+            num_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_runner,
+            seed=config.seed,
+        )
+        self.buffer = TransitionReplayBuffer(config.buffer_capacity, seed=config.seed)
+        self.num_env_steps = 0
+        self.num_updates = 0
+        self.iteration = 0
+        self.env_runner_group.sync_weights(jax.device_get(self.params))
+
+    # ------------------------------------------------------------- update
+    def _update_impl(self, params, target_q, opt, key, batch):
+        import optax
+
+        cfg = self.config
+        m = self.module
+        obs, act = batch["obs"], batch["actions"]
+        k1, k2 = jax.random.split(key)
+
+        # ---- critics
+        next_a, next_logp = m.pi_sample(params, k1, batch["next_obs"])
+        alpha = jnp.exp(params["log_alpha"])
+        q_next = jnp.minimum(
+            m.q_value(target_q["q1"], batch["next_obs"], next_a),
+            m.q_value(target_q["q2"], batch["next_obs"], next_a),
+        )
+        target = batch["rewards"] + cfg.gamma * (1.0 - batch["terminateds"]) * (
+            q_next - alpha * next_logp
+        )
+        target = jax.lax.stop_gradient(target)
+
+        def q_loss_fn(qs):
+            l1 = jnp.mean((m.q_value(qs["q1"], obs, act) - target) ** 2)
+            l2 = jnp.mean((m.q_value(qs["q2"], obs, act) - target) ** 2)
+            return l1 + l2
+
+        qs = {"q1": params["q1"], "q2": params["q2"]}
+        q_loss, q_grads = jax.value_and_grad(q_loss_fn)(qs)
+        q_updates, opt_q = self._tx["q"].update(q_grads, opt["q"], qs)
+        qs = optax.apply_updates(qs, q_updates)
+
+        # ---- actor
+        def pi_loss_fn(pi):
+            a, logp = m.pi_sample({**params, "pi": pi}, k2, obs)
+            q = jnp.minimum(m.q_value(qs["q1"], obs, a), m.q_value(qs["q2"], obs, a))
+            return jnp.mean(alpha * logp - q), logp
+
+        (pi_loss, logp), pi_grads = jax.value_and_grad(pi_loss_fn, has_aux=True)(
+            params["pi"]
+        )
+        pi_updates, opt_pi = self._tx["pi"].update(pi_grads, opt["pi"], params["pi"])
+        new_pi = optax.apply_updates(params["pi"], pi_updates)
+
+        # ---- temperature
+        def alpha_loss_fn(log_alpha):
+            return -jnp.mean(
+                jnp.exp(log_alpha) * jax.lax.stop_gradient(logp + self.target_entropy)
+            )
+
+        a_loss, a_grad = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"])
+        a_update, opt_a = self._tx["alpha"].update(a_grad, opt["alpha"], params["log_alpha"])
+        new_log_alpha = optax.apply_updates(params["log_alpha"], a_update)
+
+        # ---- polyak targets
+        new_target = jax.tree_util.tree_map(
+            lambda t, o: (1 - cfg.tau) * t + cfg.tau * o, target_q, qs
+        )
+        new_params = {
+            "pi": new_pi, "q1": qs["q1"], "q2": qs["q2"], "log_alpha": new_log_alpha,
+        }
+        new_opt = {"pi": opt_pi, "q": opt_q, "alpha": opt_a}
+        metrics = {
+            "q_loss": q_loss,
+            "pi_loss": pi_loss,
+            "alpha_loss": a_loss,
+            "alpha": jnp.exp(new_log_alpha),
+            "entropy": -jnp.mean(logp),
+        }
+        return new_params, new_target, new_opt, metrics
+
+    # -------------------------------------------------------------- train
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        for ro in self.env_runner_group.sample(cfg.rollout_length):
+            self.num_env_steps += self.buffer.add_rollout(ro)
+
+        metrics: Dict[str, float] = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            last = None
+            for _ in range(cfg.updates_per_iteration):
+                batch = {
+                    k: jnp.asarray(v) for k, v in self.buffer.sample(cfg.train_batch_size).items()
+                }
+                self._key, sub = jax.random.split(self._key)
+                self.params, self.target_q, self._opt, last = self._update(
+                    self.params, self.target_q, self._opt, sub, batch
+                )
+                self.num_updates += 1
+            if last is not None:
+                metrics = {k: float(v) for k, v in last.items()}
+                self.env_runner_group.sync_weights(jax.device_get(self.params))
+
+        self.iteration += 1
+        returns = self.env_runner_group.episode_returns()
+        return {
+            "iteration": self.iteration,
+            "num_env_steps_sampled": self.num_env_steps,
+            "num_updates": self.num_updates,
+            "buffer_size": len(self.buffer),
+            "episode_return_mean": float(np.mean(returns)) if returns else float("nan"),
+            "num_episodes": len(returns),
+            **metrics,
+        }
+
+    # --------------------------------------------------------- checkpoint
+    def save(self, directory: str) -> None:
+        from ..train.checkpoint import save_pytree
+
+        save_pytree(
+            {
+                "params": jax.device_get(self.params),
+                "target_q": jax.device_get(self.target_q),
+                "counters": {
+                    "num_env_steps": self.num_env_steps,
+                    "num_updates": self.num_updates,
+                    "iteration": self.iteration,
+                },
+            },
+            directory,
+        )
+
+    def restore(self, directory: str) -> None:
+        from ..train.checkpoint import load_pytree
+
+        data = load_pytree(directory)
+        self.params = data["params"]
+        self.target_q = data["target_q"]
+        counters = data.get("counters", {})
+        self.num_env_steps = int(counters.get("num_env_steps", 0))
+        self.num_updates = int(counters.get("num_updates", 0))
+        self.iteration = int(counters.get("iteration", 0))
+        self._opt = {
+            "pi": self._tx["pi"].init(self.params["pi"]),
+            "q": self._tx["q"].init({"q1": self.params["q1"], "q2": self.params["q2"]}),
+            "alpha": self._tx["alpha"].init(self.params["log_alpha"]),
+        }
+        self.env_runner_group.sync_weights(jax.device_get(self.params))
