@@ -15,6 +15,7 @@ from .api import (  # noqa: F401
     cluster_resources,
     get,
     get_actor,
+    get_runtime_context,
     init,
     is_initialized,
     kill,
@@ -26,6 +27,7 @@ from .api import (  # noqa: F401
     wait,
 )
 from .core.placement_group import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
     placement_group,
     remove_placement_group,
@@ -44,6 +46,7 @@ __all__ = [
     "kill",
     "cancel",
     "get_actor",
+    "get_runtime_context",
     "cluster_resources",
     "available_resources",
     "nodes",
@@ -51,5 +54,6 @@ __all__ = [
     "placement_group",
     "remove_placement_group",
     "PlacementGroupSchedulingStrategy",
+    "NodeAffinitySchedulingStrategy",
     "exceptions",
 ]

@@ -24,7 +24,11 @@ from . import exceptions as exc
 from .core import runtime_base
 from .core.ids import ActorID, TaskID
 from .core.object_ref import ObjectRef
-from .core.placement_group import PlacementGroupHandle, PlacementGroupSchedulingStrategy
+from .core.placement_group import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupHandle,
+    PlacementGroupSchedulingStrategy,
+)
 from .core.resources import task_resources
 from .core.runtime_base import current_runtime, is_initialized
 from .core.task_spec import ArgRef, FunctionTable, SchedulingOptions, TaskSpec, TaskType
@@ -164,7 +168,7 @@ def _build_sched_options(opts: Dict[str, Any]) -> SchedulingOptions:
         wd = renv.get("working_dir")
         if wd is not None and not isinstance(wd, str):
             raise TypeError("runtime_env['working_dir'] must be a path string")
-    strategy = opts.get("scheduling_strategy", "DEFAULT")
+    strategy = opts.get("scheduling_strategy") or "DEFAULT"
     pg_id = None
     bundle_index = opts.get("placement_group_bundle_index", -1)
     if isinstance(strategy, PlacementGroupSchedulingStrategy):
@@ -172,9 +176,13 @@ def _build_sched_options(opts: Dict[str, Any]) -> SchedulingOptions:
         bundle_index = strategy.placement_group_bundle_index
         pg_id = pg.id_hex
         strategy = "PLACEMENT_GROUP"
+    elif isinstance(strategy, NodeAffinitySchedulingStrategy):
+        strategy = f"NODE:{strategy.node_id}:{'soft' if strategy.soft else 'hard'}"
     elif isinstance(opts.get("placement_group"), PlacementGroupHandle):
         pg_id = opts["placement_group"].id_hex
         strategy = "PLACEMENT_GROUP"
+    elif strategy not in ("DEFAULT", "SPREAD"):
+        raise ValueError(f"unknown scheduling_strategy {strategy!r}")
     return SchedulingOptions(
         resources=task_resources(
             num_cpus=opts.get("num_cpus"),
@@ -498,3 +506,11 @@ def available_resources() -> Dict[str, float]:
 
 def nodes() -> List[dict]:
     return current_runtime().nodes()
+
+
+def get_runtime_context():
+    """Introspects the current driver/worker/task context (reference:
+    python/ray/runtime_context.py get_runtime_context)."""
+    from .core.runtime_context import get_runtime_context as _grc
+
+    return _grc()

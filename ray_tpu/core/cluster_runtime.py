@@ -63,6 +63,7 @@ def _entry_from_spec(spec: TaskSpec) -> dict:
         "max_concurrency": spec.options.max_concurrency,
         "runtime_env": spec.options.runtime_env,
         "attempt": 0,
+        "strategy": spec.options.scheduling_strategy,
         "pg_id": spec.options.placement_group_id,
         "bundle_index": spec.options.bundle_index,
         "name": spec.options.name,
@@ -104,6 +105,10 @@ class ClusterRuntime(Runtime):
         self._session_dir = session_dir
         self._procs = procs or []
         self._driver = driver
+        # Context identity (reference: runtime_context.py): workers override
+        # _worker_id with their raylet-assigned id after attach.
+        self._worker_id = f"driver-{os.getpid()}" if driver else f"worker-{os.getpid()}"
+        self._namespace = "default"
         self._actor_location: Dict[str, str] = {}  # actor_id -> raylet sock
         self._raylet_clients: Dict[str, RpcClient] = {}
         self._shutdown_done = False
@@ -117,6 +122,11 @@ class ClusterRuntime(Runtime):
         self._records: Dict[str, _TaskRecord] = {}
         self._pending_free: List[str] = []
         self._borrow_buf: Dict[str, int] = {}
+        # Oids whose refs were serialized out of this process (task args,
+        # refs nested in put values): another process may borrow them, so
+        # their frees must ride the GCS borrow-grace path. Everything else
+        # is freed from the local pool eagerly on last-ref drop.
+        self._escaped: set = set()
         self._dropped_records: List[_TaskRecord] = []
         self._free_wake = threading.Event()
         self._free_thread = threading.Thread(
@@ -194,15 +204,19 @@ class ClusterRuntime(Runtime):
         num_workers: Optional[int] = None,
     ) -> "ClusterRuntime":
         if address:
-            return cls.connect(address)
-        cluster = Cluster(
-            num_cpus=num_cpus,
-            num_tpus=num_tpus,
-            resources=resources,
-            object_store_memory=object_store_memory,
-            num_workers=num_workers,
-        )
-        return cluster.runtime()
+            rt = cls.connect(address)
+        else:
+            cluster = Cluster(
+                num_cpus=num_cpus,
+                num_tpus=num_tpus,
+                resources=resources,
+                object_store_memory=object_store_memory,
+                num_workers=num_workers,
+            )
+            rt = cluster.runtime()
+        if namespace:
+            rt._namespace = namespace
+        return rt
 
     @classmethod
     def connect(cls, session_dir: str) -> "ClusterRuntime":
@@ -249,8 +263,13 @@ class ClusterRuntime(Runtime):
         if borrowed:
             self._free_wake.set()
 
+    def mark_escaped(self, object_id: ObjectID) -> None:
+        with self._ref_lock:
+            self._escaped.add(object_id.hex())
+
     def remove_local_ref(self, object_id: ObjectID) -> None:
         freed = False
+        eager: List[str] = []
         with self._ref_lock:
             # Iterative cascade: freeing an output releases its task's
             # lineage pins on the deps, which may free those in turn
@@ -266,10 +285,21 @@ class ClusterRuntime(Runtime):
                 if h not in self._owned:
                     # Borrowed ref fully dropped here: return the borrow.
                     self._borrow_buf[h] = self._borrow_buf.get(h, 0) - 1
+                    self._escaped.discard(h)  # re-serialized borrows too
                     freed = True
                     continue
                 self._owned.discard(h)
                 rec = self._records.pop(h, None)
+                if h not in self._escaped:
+                    # No other process can hold a borrow (the ref never left
+                    # this one): free the pool block now so the allocator
+                    # reuses the hot low region instead of cycling through
+                    # the arena. The GCS free still runs for directory
+                    # cleanup. (reference: plasma deletes immediately when
+                    # the owner knows there are no borrowers.)
+                    eager.append(h)
+                else:
+                    self._escaped.discard(h)
                 self._pending_free.append(h)
                 freed = True
                 if rec is not None and not any(
@@ -282,6 +312,13 @@ class ClusterRuntime(Runtime):
                     # reference_count.h submitted-task count).
                     if rec.entry.get("deps"):
                         self._dropped_records.append(rec)
+        for h in eager:
+            try:
+                # Pinned readers make delete fail; the async GCS free path
+                # (which the raylet monitor retries) covers those.
+                self._store.delete(ObjectID.from_hex(h))
+            except Exception:
+                pass
         if freed:
             self._free_wake.set()
 
@@ -376,6 +413,9 @@ class ClusterRuntime(Runtime):
             for h in entry["return_ids"]:
                 self._records[h] = rec
                 self._owned.add(h)
+                # The spec ships the return ids to the executing worker,
+                # which may register a borrow: never eager-free them.
+                self._escaped.add(h)
             # Lineage-pin the arguments: they stay alive (and reconstructable)
             # while any output of this task is still referenced.
             for dep in entry.get("deps", []):
@@ -580,6 +620,7 @@ class ClusterRuntime(Runtime):
             spec.options.namespace,
             spec.options.placement_group_id,
             spec.options.bundle_index,
+            spec.options.scheduling_strategy,
         )
         self._raylet_for(node["sock"]).call(
             "create_actor", blob, True, node.get("bundle_index")

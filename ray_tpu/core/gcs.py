@@ -26,6 +26,10 @@ from ..utils.config import CONFIG
 
 HEARTBEAT_TIMEOUT_S = CONFIG.heartbeat_timeout_s
 
+
+def _is_hard_affinity(strategy: str) -> bool:
+    return bool(strategy) and strategy.startswith("NODE:") and strategy.endswith(":hard")
+
 # Finished/failed task records kept for the state API before FIFO eviction.
 TASK_TABLE_CAP = 50_000
 
@@ -281,25 +285,42 @@ class GcsService:
             return out
 
     # ------------------------------------------------- scheduling assist
-    def pick_node(self, resources: dict, exclude: Optional[List[str]] = None) -> Optional[dict]:
+    def pick_node(
+        self,
+        resources: dict,
+        exclude: Optional[List[str]] = None,
+        mode: str = "pack",
+    ) -> Optional[dict]:
         """Best-fit node for a resource request (the cluster-level half of
         the two-level scheduler; reference: cluster_resource_scheduler.h:44
-        + hybrid policy). Packs onto the most-utilized feasible node."""
+        + hybrid_scheduling_policy.h:50 / spread policy). mode="pack" picks
+        the most-utilized feasible node; mode="spread" round-robins over
+        feasible nodes (reference: SPREAD policy — the resource view lags
+        by a heartbeat, so a burst of submissions must not all land on the
+        momentarily-least-utilized node)."""
         exclude = set(exclude or [])
-        best = None
-        best_score = -1.0
         with self._lock:
-            for nid, n in self._nodes.items():
+            feasible = []
+            best = None
+            best_used = -1.0
+            for nid, n in sorted(self._nodes.items()):
                 if nid in exclude or not n["alive"]:
                     continue
                 avail = n["available"]
                 if all(avail.get(k, 0.0) >= v for k, v in resources.items()):
+                    entry = {"node_id": nid, "sock": n["sock"], "store": n["store"]}
+                    feasible.append(entry)
                     total = sum(n["resources"].values()) or 1.0
                     used = 1.0 - sum(avail.values()) / total
-                    if used > best_score:
-                        best_score = used
-                        best = {"node_id": nid, "sock": n["sock"], "store": n["store"]}
-        return best
+                    if used > best_used:
+                        best_used = used
+                        best = entry
+            if not feasible:
+                return None
+            if mode == "spread":
+                self._spread_rr = getattr(self, "_spread_rr", -1) + 1
+                return feasible[self._spread_rr % len(feasible)]
+            return best
 
     def _health_loop(self):
         tick = 0
@@ -388,6 +409,27 @@ class GcsService:
         if a.get("name") and self._named.get(key) == actor_id:
             del self._named[key]
 
+    def _place_with_strategy(self, resources: dict, strategy: str) -> Optional[dict]:
+        """Strategy-aware node choice shared by first placement AND restart
+        (a hard-pinned actor must not silently restart elsewhere). NodeAffinity
+        picks by TOTAL capacity — the raylet queues until resources free."""
+        if strategy and strategy.startswith("NODE:"):
+            _, target_id, softness = strategy.split(":", 2)
+            with self._lock:
+                n = self._nodes.get(target_id)
+                if (
+                    n is not None
+                    and n["alive"]
+                    and all(
+                        n["resources"].get(k, 0.0) >= v for k, v in resources.items()
+                    )
+                ):
+                    return {"node_id": target_id, "sock": n["sock"], "store": n["store"]}
+            if softness == "hard":
+                return None
+            return self.pick_node(resources)
+        return self.pick_node(resources, mode="spread" if strategy == "SPREAD" else "pack")
+
     def register_actor(
         self,
         actor_id: str,
@@ -398,6 +440,7 @@ class GcsService:
         namespace: Optional[str],
         pg_id: Optional[str] = None,
         bundle_index: int = -1,
+        strategy: str = "DEFAULT",
     ) -> dict:
         """Registers + places an actor; returns the chosen node (the caller
         raylet/driver forwards the creation there). Reference:
@@ -419,15 +462,24 @@ class GcsService:
                         f"placement group {pg_id[:8]} bundle {bundle_index} not available"
                     )
             else:
-                # The resource view lags a heartbeat behind a task burst:
-                # give it a couple of periods to catch up before refusing.
-                deadline = time.monotonic() + 3 * CONFIG.heartbeat_interval_s
-                while True:
-                    node = self.pick_node(resources)
-                    if node is not None or time.monotonic() > deadline:
-                        break
-                    time.sleep(0.1)
+                node = self._place_with_strategy(resources, strategy)
+                if node is None and not _is_hard_affinity(strategy):
+                    # The resource view lags a heartbeat behind a task burst:
+                    # give it a couple of periods to catch up before refusing.
+                    # (Hard affinity is a totals-based static check — waiting
+                    # cannot change the answer.)
+                    deadline = time.monotonic() + 3 * CONFIG.heartbeat_interval_s
+                    while time.monotonic() <= deadline:
+                        node = self._place_with_strategy(resources, strategy)
+                        if node is not None:
+                            break
+                        time.sleep(0.1)
                 if node is None:
+                    if _is_hard_affinity(strategy):
+                        raise RuntimeError(
+                            f"hard NodeAffinity to {strategy.split(':')[1][:12]} "
+                            f"cannot be satisfied for actor requiring {resources}"
+                        )
                     raise RuntimeError(f"no node can host actor requiring {resources}")
         except BaseException:
             if key is not None:
@@ -445,6 +497,7 @@ class GcsService:
                 "num_restarts": 0,
                 "pg_id": pg_id,
                 "bundle_index": node.get("bundle_index", bundle_index) if pg_id else -1,
+                "strategy": strategy,
                 "name": name,
                 "namespace": namespace or "default",
                 "death_reason": "",
@@ -477,16 +530,23 @@ class GcsService:
             resources = dict(a["resources"])
             pg_id = a.get("pg_id")
             bundle_index = a.get("bundle_index", -1)
+            strategy = a.get("strategy", "DEFAULT")
         if pg_id:
             # Bundle-pinned actors restart on their reserved bundle.
             node = self.pick_bundle(pg_id, bundle_index)
         else:
-            node = self.pick_node(resources)
+            # Restart honors the creation strategy: a hard-pinned actor
+            # whose node is gone dies instead of migrating silently.
+            node = self._place_with_strategy(resources, strategy)
         with self._lock:
             a = self._actors[actor_id]
             if node is None:
                 a["state"] = "DEAD"
-                a["death_reason"] = f"{reason}; no node for restart"
+                a["death_reason"] = (
+                    f"{reason}; hard NodeAffinity target unavailable for restart"
+                    if _is_hard_affinity(strategy)
+                    else f"{reason}; no node for restart"
+                )
                 self._drop_name(actor_id)
                 return {"restart": False}
             a["node_id"] = node["node_id"]

@@ -70,6 +70,12 @@ class ObjectRef:
     def __reduce__(self):
         # Serializing a ref inside task args/returns transfers a borrow; the
         # receiving process re-binds it to its own runtime on deserialization.
+        # The owner remembers the escape: objects whose refs never left the
+        # process can be freed from the pool eagerly on last-ref drop
+        # (reference: reference_count.h borrower bookkeeping — Ray frees
+        # immediately when it knows there are no borrowers).
+        if self._runtime is not None:
+            self._runtime.mark_escaped(self._id)
         return (ObjectRef._from_wire, (self._id.binary(), self._owner_addr))
 
     @staticmethod

@@ -70,3 +70,14 @@ class PlacementGroupSchedulingStrategy:
     placement_group: PlacementGroupHandle
     placement_group_bundle_index: int = -1
     placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    """Pin a task/actor to a specific node (reference:
+    python/ray/util/scheduling_strategies.py NodeAffinitySchedulingStrategy).
+    hard (soft=False): fail the task if the node is gone; soft=True: fall
+    back to default placement."""
+
+    node_id: str
+    soft: bool = False

@@ -363,6 +363,92 @@ class RayletService:
             self._sched_wake.set()
             return entry["return_ids"]
         if not forwarded:
+            strategy = entry.get("strategy") or "DEFAULT"
+            if strategy.startswith("NODE:"):
+                # NodeAffinity (reference: scheduling_strategies.py
+                # NodeAffinitySchedulingStrategy): route to the named node;
+                # hard affinity fails when the node is gone, soft falls
+                # back to default placement.
+                _, target_id, softness = strategy.split(":", 2)
+                if target_id != self.node_id:
+                    # Retry the lookup briefly: a transient GCS hiccup must
+                    # not convert hard affinity into a permanent failure.
+                    info = None
+                    looked_up = False
+                    for _ in range(3):
+                        try:
+                            info = self.gcs.call("node_info", target_id)
+                            looked_up = True
+                            break
+                        except Exception:
+                            time.sleep(0.3)
+                    if info is not None and info.get("alive"):
+                        total = info.get("resources") or {}
+                        if not all(
+                            total.get(k, 0.0) >= v for k, v in resources.items()
+                        ):
+                            # Target can never run it: fail hard affinity
+                            # here — the forwarded path skips feasibility.
+                            if softness == "hard":
+                                self._store_error_for(
+                                    entry,
+                                    RuntimeError(
+                                        f"hard NodeAffinity to {target_id[:12]}: "
+                                        f"node cannot ever satisfy {resources}"
+                                    ),
+                                )
+                                return entry["return_ids"]
+                            info = None  # soft: fall back to default
+                        else:
+                            try:
+                                return self._remote(info["sock"]).call(
+                                    "submit_task", spec_blob, True
+                                )
+                            except Exception:
+                                info = None  # died mid-forward
+                    if softness == "hard":
+                        self._store_error_for(
+                            entry,
+                            RuntimeError(
+                                f"hard NodeAffinity to {target_id[:12]} cannot "
+                                "be satisfied: "
+                                + ("node is gone" if looked_up else "GCS unreachable")
+                            ),
+                        )
+                        return entry["return_ids"]
+                    # soft: fall through to default placement below
+                elif not self._fits_total(resources):
+                    if softness == "hard":
+                        self._store_error_for(
+                            entry,
+                            RuntimeError(
+                                f"hard NodeAffinity to {target_id[:12]}: node "
+                                f"cannot ever satisfy {resources}"
+                            ),
+                        )
+                        return entry["return_ids"]
+                    # soft + infeasible here: fall through to default
+                    # placement (spillback finds a capable node).
+                else:
+                    # Affinity to this node: queue here, skip spillback.
+                    entry["type"] = "task"
+                    self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
+                    self._pending.put(entry)
+                    self._sched_wake.set()
+                    return entry["return_ids"]
+            elif strategy == "SPREAD":
+                # Round-robin over feasible nodes (reference: spread policy,
+                # scheduling_strategy="SPREAD"). Not gated on the cached
+                # cluster size: it lags a heartbeat behind node additions,
+                # and an explicit SPREAD request justifies the GCS hop.
+                try:
+                    target = self.gcs.call("pick_node", resources, [], "spread")
+                    if target is not None and target["node_id"] != self.node_id:
+                        return self._remote(target["sock"]).call(
+                            "submit_task", spec_blob, True
+                        )
+                except Exception:
+                    pass  # fall back to local/default placement
             # Cluster-level decision: if it can't run here (ever, or not
             # soon) and another node has room now, forward it.
             if not self._fits_total(resources):

@@ -70,6 +70,11 @@ class Runtime(ABC):
     def remove_local_ref(self, object_id: ObjectID) -> None:
         pass
 
+    def mark_escaped(self, object_id: ObjectID) -> None:
+        """Records that a ref to this object was serialized out of this
+        process (so another process may borrow it)."""
+        pass
+
     # ---- tasks ----
     @abstractmethod
     def submit_task(self, spec: TaskSpec) -> List[ObjectID]: ...

@@ -121,6 +121,7 @@ def main(argv: List[str]) -> None:
         node_id=node_id,
         driver=False,
     )
+    runtime._worker_id = worker_id
     runtime_base.set_runtime(runtime)
 
     actor_instance: Dict[str, Any] = {}  # actor_id -> instance
@@ -175,7 +176,10 @@ def main(argv: List[str]) -> None:
 
     def run_body(entry: dict, sealed: List[str]) -> bool:
         """Executes one entry body synchronously (any thread)."""
+        from .runtime_context import reset_task_context, set_task_context
+
         kind = entry["type"]
+        token = set_task_context(entry.get("task_id"), entry.get("actor_id"))
         try:
             if kind == "task":
                 fn = GLOBAL_FUNCTION_TABLE.loads(entry["func_blob"], entry["func_hash"])
@@ -214,6 +218,8 @@ def main(argv: List[str]) -> None:
         except BaseException as e:  # noqa: BLE001
             store_error(entry, e, sealed)
             return False
+        finally:
+            reset_task_context(token)
 
     def done(entry: dict, ok: bool, sealed: List[str]) -> None:
         raylet.notify("worker_done", worker_id, ok, sealed, entry.get("task_id"))
@@ -224,6 +230,9 @@ def main(argv: List[str]) -> None:
 
     def create_actor(entry: dict, sealed: List[str]) -> bool:
         nonlocal pool, aio
+        from .runtime_context import set_task_context
+
+        set_task_context(entry.get("task_id"), entry.get("actor_id"))
         try:
             cls = GLOBAL_FUNCTION_TABLE.loads(entry["func_blob"], entry["func_hash"])
             args, kwargs = _resolve_args(store, entry["args_blob"], raylet)
@@ -261,6 +270,10 @@ def main(argv: List[str]) -> None:
         async def coro():
             import asyncio
 
+            from .runtime_context import set_task_context
+
+            # Scoped to this asyncio task's context copy; no reset needed.
+            set_task_context(entry.get("task_id"), entry.get("actor_id"))
             # Arg resolution can block (remote/spilled deps): keep it off
             # the event loop thread or all concurrent coroutines stall.
             args, kwargs = await asyncio.get_running_loop().run_in_executor(

@@ -96,12 +96,13 @@ class JaxLearner:
 
     # Checkpointable (reference: rllib/utils/checkpoints.py Checkpointable)
     def save_state(self, directory: str) -> None:
-        from ..train.checkpoint import save_pytree
+        from ..train.checkpoint import save_aux_state, save_pytree
 
         save_pytree({"params": jax.device_get(self.params)}, directory)
+        save_aux_state(directory, jax.device_get(self.opt_state))
 
     def load_state(self, directory: str) -> None:
-        from ..train.checkpoint import load_pytree
+        from ..train.checkpoint import load_aux_state, load_pytree
 
         params = load_pytree(directory)["params"]
         if self.mesh is not None:
@@ -111,7 +112,15 @@ class JaxLearner:
 
             params = jax.device_put(params, replicated(self.mesh))
         self.params = params
-        self.opt_state = self.tx.init(self.params)
+        opt_state = load_aux_state(directory)
+        if opt_state is not None:
+            if self.mesh is not None:
+                from ..parallel.sharding import replicated
+
+                opt_state = jax.device_put(opt_state, replicated(self.mesh))
+            self.opt_state = opt_state
+        else:  # old checkpoint: fresh moments
+            self.opt_state = self.tx.init(self.params)
 
 
 class _DistributedLearner:

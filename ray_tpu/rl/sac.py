@@ -300,6 +300,12 @@ class SAC:
             },
             directory,
         )
+        from ..train.checkpoint import save_aux_state
+
+        save_aux_state(
+            directory,
+            {"opt": jax.device_get(self._opt), "key": jax.device_get(self._key)},
+        )
 
     def restore(self, directory: str) -> None:
         from ..train.checkpoint import load_pytree
@@ -311,9 +317,16 @@ class SAC:
         self.num_env_steps = int(counters.get("num_env_steps", 0))
         self.num_updates = int(counters.get("num_updates", 0))
         self.iteration = int(counters.get("iteration", 0))
-        self._opt = {
-            "pi": self._tx["pi"].init(self.params["pi"]),
-            "q": self._tx["q"].init({"q1": self.params["q1"], "q2": self.params["q2"]}),
-            "alpha": self._tx["alpha"].init(self.params["log_alpha"]),
-        }
+        from ..train.checkpoint import load_aux_state
+
+        aux = load_aux_state(directory)
+        if aux is not None:
+            self._opt = aux["opt"]
+            self._key = jnp.asarray(aux["key"])
+        else:  # pre-opt-state checkpoint: fresh moments is the best we can do
+            self._opt = {
+                "pi": self._tx["pi"].init(self.params["pi"]),
+                "q": self._tx["q"].init({"q1": self.params["q1"], "q2": self.params["q2"]}),
+                "alpha": self._tx["alpha"].init(self.params["log_alpha"]),
+            }
         self.env_runner_group.sync_weights(jax.device_get(self.params))

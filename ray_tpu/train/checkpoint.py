@@ -111,6 +111,25 @@ def load_pytree(directory: str, like: Any = None) -> Any:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def save_aux_state(directory: str, payload: Any) -> None:
+    """Pickles host-resident auxiliary training state (optimizer moments,
+    RNG keys) alongside a pytree checkpoint. Kept out of save_pytree because
+    optax NamedTuple structure does not survive an orbax metadata-restore;
+    a resume must continue the same optimizer trajectory."""
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, "opt_state.pkl"), "wb") as f:
+        pickle.dump(payload, f)
+
+
+def load_aux_state(directory: str) -> Optional[Any]:
+    """Inverse of save_aux_state; None when the checkpoint predates it."""
+    path = os.path.join(directory, "opt_state.pkl")
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
 @dataclasses.dataclass
 class _TrackedCheckpoint:
     checkpoint: Checkpoint

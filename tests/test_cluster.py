@@ -314,3 +314,82 @@ def test_removed_pg_task_fails_fast(cluster_rt):
 
     with pytest.raises(Exception, match="not\\b.*(reserved|schedulable)|removed"):
         rt.get(pinned.options(scheduling_strategy=strat).remote(), timeout=30)
+
+
+def test_spread_scheduling_strategy(two_node):
+    """SPREAD routes tasks to the least-utilized feasible node (reference:
+    scheduling_strategies.py SPREAD / raylet spread policy)."""
+    rt, cluster, node2 = two_node
+
+    @rt.remote(num_cpus=1)
+    def where():
+        from ray_tpu.core.runtime_context import get_runtime_context
+
+        return get_runtime_context().get_node_id()
+
+    seen = set(
+        rt.get(
+            [where.options(scheduling_strategy="SPREAD").remote() for _ in range(8)],
+            timeout=60,
+        )
+    )
+    assert len(seen) == 2, f"SPREAD used only nodes {seen}"
+
+
+def test_node_affinity_hard_and_soft(two_node):
+    rt, cluster, node2 = two_node
+    from ray_tpu.core.placement_group import NodeAffinitySchedulingStrategy
+
+    nodes = {n["NodeID"] for n in rt.nodes()}
+    assert node2 in nodes
+
+    @rt.remote(num_cpus=1)
+    def where():
+        from ray_tpu.core.runtime_context import get_runtime_context
+
+        return get_runtime_context().get_node_id()
+
+    hard = NodeAffinitySchedulingStrategy(node_id=node2, soft=False)
+    got = rt.get(
+        [where.options(scheduling_strategy=hard).remote() for _ in range(3)],
+        timeout=60,
+    )
+    assert set(got) == {node2}
+
+    # Hard affinity to a nonexistent node fails visibly.
+    bogus = NodeAffinitySchedulingStrategy(node_id="f" * 32, soft=False)
+    with pytest.raises(Exception, match="NodeAffinity"):
+        rt.get(where.options(scheduling_strategy=bogus).remote(), timeout=30)
+
+    # Soft affinity to a nonexistent node falls back and still runs.
+    soft = NodeAffinitySchedulingStrategy(node_id="f" * 32, soft=True)
+    assert rt.get(where.options(scheduling_strategy=soft).remote(), timeout=30) in nodes
+
+
+def test_runtime_context_task_ids(cluster_rt):
+    rt = cluster_rt
+
+    @rt.remote
+    def ctx():
+        from ray_tpu.core.runtime_context import get_runtime_context
+
+        c = get_runtime_context()
+        return (c.get_node_id(), c.get_task_id(), c.get_actor_id())
+
+    node_id, task_id, actor_id = rt.get(ctx.remote(), timeout=60)
+    assert node_id and task_id and actor_id is None
+
+    @rt.remote
+    class A:
+        def ids(self):
+            from ray_tpu.core.runtime_context import get_runtime_context
+
+            c = get_runtime_context()
+            return (c.get_task_id(), c.get_actor_id())
+
+    a = A.remote()
+    task_id, actor_id = rt.get(a.ids.remote(), timeout=60)
+    assert task_id and actor_id
+    # Driver-side context: node id known, no task.
+    c = rt.get_runtime_context()
+    assert c.get_node_id() and c.get_task_id() is None

@@ -194,3 +194,42 @@ def test_lineage_reconstruction_two_deep(two_node):
     cluster.add_node(num_cpus=2, resources={"spot": 1.0})
     val = rt.get(b, timeout=60)
     assert val.sum() == 7 * 2 * 1000
+
+
+def test_eager_free_non_escaped_put(rt_cluster):
+    """An object whose ref never left the process is freed from the pool
+    synchronously on last-ref drop (no GCS grace roundtrip) — the basis of
+    the hot put/del allocator reuse path."""
+    import numpy as np
+
+    from ray_tpu.core.runtime_base import current_runtime
+
+    rt = rt_cluster
+    store = current_runtime()._store
+    baseline = store.bytes_in_use()
+    ref = rt.put(np.zeros(8 << 20, dtype=np.uint8))
+    assert store.bytes_in_use() >= baseline + (8 << 20)
+    del ref
+    # No waiting: the delete happened in remove_local_ref itself.
+    assert store.bytes_in_use() <= baseline + (64 << 10)
+
+
+def test_escaped_put_ref_not_eagerly_freed(rt_cluster):
+    """A ref that was shipped to a task keeps its object alive through the
+    GCS borrow-grace path; the value stays fetchable mid-flight."""
+    import numpy as np
+
+    rt = rt_cluster
+
+    @rt.remote
+    def consume(x):
+        import time as _t
+
+        _t.sleep(0.5)
+        return float(x.sum())
+
+    arr = np.ones(1 << 20, dtype=np.float32)
+    ref = rt.put(arr)
+    out_ref = consume.remote(ref)
+    del ref  # the task (maybe not yet started) still needs the object
+    assert rt.get(out_ref, timeout=60) == float(1 << 20)
