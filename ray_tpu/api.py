@@ -177,7 +177,9 @@ def _build_sched_options(opts: Dict[str, Any]) -> SchedulingOptions:
         pg_id = pg.id_hex
         strategy = "PLACEMENT_GROUP"
     elif isinstance(strategy, NodeAffinitySchedulingStrategy):
-        strategy = f"NODE:{strategy.node_id}:{'soft' if strategy.soft else 'hard'}"
+        from .core.placement_group import encode_node_affinity
+
+        strategy = encode_node_affinity(strategy.node_id, strategy.soft)
     elif isinstance(opts.get("placement_group"), PlacementGroupHandle):
         pg_id = opts["placement_group"].id_hex
         strategy = "PLACEMENT_GROUP"

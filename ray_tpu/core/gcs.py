@@ -28,7 +28,10 @@ HEARTBEAT_TIMEOUT_S = CONFIG.heartbeat_timeout_s
 
 
 def _is_hard_affinity(strategy: str) -> bool:
-    return bool(strategy) and strategy.startswith("NODE:") and strategy.endswith(":hard")
+    from .placement_group import decode_node_affinity
+
+    aff = decode_node_affinity(strategy)
+    return aff is not None and not aff[1]
 
 # Finished/failed task records kept for the state API before FIFO eviction.
 TASK_TABLE_CAP = 50_000
@@ -413,8 +416,11 @@ class GcsService:
         """Strategy-aware node choice shared by first placement AND restart
         (a hard-pinned actor must not silently restart elsewhere). NodeAffinity
         picks by TOTAL capacity — the raylet queues until resources free."""
-        if strategy and strategy.startswith("NODE:"):
-            _, target_id, softness = strategy.split(":", 2)
+        from .placement_group import decode_node_affinity
+
+        aff = decode_node_affinity(strategy)
+        if aff is not None:
+            target_id, soft = aff
             with self._lock:
                 n = self._nodes.get(target_id)
                 if (
@@ -425,7 +431,7 @@ class GcsService:
                     )
                 ):
                     return {"node_id": target_id, "sock": n["sock"], "store": n["store"]}
-            if softness == "hard":
+            if not soft:
                 return None
             return self.pick_node(resources)
         return self.pick_node(resources, mode="spread" if strategy == "SPREAD" else "pack")

@@ -81,3 +81,17 @@ class NodeAffinitySchedulingStrategy:
 
     node_id: str
     soft: bool = False
+
+
+def encode_node_affinity(node_id: str, soft: bool) -> str:
+    """Wire form of NodeAffinity carried in SchedulingOptions — the single
+    source of truth for the format (decoded by the raylet and GCS)."""
+    return f"NODE:{node_id}:{'soft' if soft else 'hard'}"
+
+
+def decode_node_affinity(strategy: str):
+    """Returns (node_id, soft) or None when the strategy isn't NodeAffinity."""
+    if not strategy or not strategy.startswith("NODE:"):
+        return None
+    _, node_id, softness = strategy.split(":", 2)
+    return node_id, softness == "soft"

@@ -33,6 +33,7 @@ from .. import exceptions as exc
 from ..utils.config import CONFIG
 from .ids import ObjectID
 from .object_transport import StoredError
+from .placement_group import decode_node_affinity
 from .rpc import RpcClient, RpcServer
 from .shm_store import SharedMemoryStore
 
@@ -353,8 +354,24 @@ class RayletService:
     def submit_task(self, spec_blob: bytes, forwarded: bool = False) -> List[bytes]:
         """Queues a normal task; returns return-object ids. May forward to
         another node (spillback, reference: cluster_task_manager.cc:136)."""
-        entry = pickle.loads(spec_blob)
+        return self._ingest_entry(pickle.loads(spec_blob), spec_blob, forwarded)
+
+    def submit_task_batch(self, batch_blob: bytes) -> int:
+        """Batched one-way submission: owners coalesce bursts into one
+        message, collapsing per-task RPC overhead (reference: the
+        submission-queue batching in NormalTaskSubmitter)."""
+        entries = pickle.loads(batch_blob)
+        for entry in entries:
+            self._ingest_entry(entry, None, False)
+        return len(entries)
+
+    def _ingest_entry(
+        self, entry: dict, spec_blob: Optional[bytes], forwarded: bool
+    ) -> List[bytes]:
         resources = entry["resources"]
+
+        def blob() -> bytes:  # batched path: re-frame only when forwarding
+            return spec_blob if spec_blob is not None else pickle.dumps(entry)
         if entry.get("pg_id"):
             # Bundle-pinned: the driver routed it to this node; never spill.
             entry["type"] = "task"
@@ -364,61 +381,26 @@ class RayletService:
             return entry["return_ids"]
         if not forwarded:
             strategy = entry.get("strategy") or "DEFAULT"
-            if strategy.startswith("NODE:"):
+            affinity = decode_node_affinity(strategy)
+            if affinity is not None:
                 # NodeAffinity (reference: scheduling_strategies.py
                 # NodeAffinitySchedulingStrategy): route to the named node;
                 # hard affinity fails when the node is gone, soft falls
                 # back to default placement.
-                _, target_id, softness = strategy.split(":", 2)
+                target_id, soft = affinity
                 if target_id != self.node_id:
-                    # Retry the lookup briefly: a transient GCS hiccup must
-                    # not convert hard affinity into a permanent failure.
-                    info = None
-                    looked_up = False
-                    for _ in range(3):
-                        try:
-                            info = self.gcs.call("node_info", target_id)
-                            looked_up = True
-                            break
-                        except Exception:
-                            time.sleep(0.3)
-                    if info is not None and info.get("alive"):
-                        total = info.get("resources") or {}
-                        if not all(
-                            total.get(k, 0.0) >= v for k, v in resources.items()
-                        ):
-                            # Target can never run it: fail hard affinity
-                            # here — the forwarded path skips feasibility.
-                            if softness == "hard":
-                                self._store_error_for(
-                                    entry,
-                                    RuntimeError(
-                                        f"hard NodeAffinity to {target_id[:12]}: "
-                                        f"node cannot ever satisfy {resources}"
-                                    ),
-                                )
-                                return entry["return_ids"]
-                            info = None  # soft: fall back to default
-                        else:
-                            try:
-                                return self._remote(info["sock"]).call(
-                                    "submit_task", spec_blob, True
-                                )
-                            except Exception:
-                                info = None  # died mid-forward
-                    if softness == "hard":
-                        self._store_error_for(
-                            entry,
-                            RuntimeError(
-                                f"hard NodeAffinity to {target_id[:12]} cannot "
-                                "be satisfied: "
-                                + ("node is gone" if looked_up else "GCS unreachable")
-                            ),
-                        )
-                        return entry["return_ids"]
-                    # soft: fall through to default placement below
-                elif not self._fits_total(resources):
-                    if softness == "hard":
+                    # Off the handler thread: the GCS lookup retries on
+                    # hiccups, and submit_task is a one-way notify whose
+                    # handler must not stall the submission pipeline
+                    # (same pattern as _place_elsewhere).
+                    threading.Thread(
+                        target=self._place_affinity,
+                        args=(entry, blob(), target_id, soft),
+                        daemon=True,
+                    ).start()
+                    return entry["return_ids"]
+                if not self._fits_total(resources):
+                    if not soft:
                         self._store_error_for(
                             entry,
                             RuntimeError(
@@ -445,7 +427,7 @@ class RayletService:
                     target = self.gcs.call("pick_node", resources, [], "spread")
                     if target is not None and target["node_id"] != self.node_id:
                         return self._remote(target["sock"]).call(
-                            "submit_task", spec_blob, True
+                            "submit_task", blob(), True
                         )
                 except Exception:
                     pass  # fall back to local/default placement
@@ -457,7 +439,7 @@ class RayletService:
                 # appear), and the submit RPC is one-way so a failure must
                 # surface as a stored error object, not a raise.
                 threading.Thread(
-                    target=self._place_elsewhere, args=(entry, spec_blob), daemon=True
+                    target=self._place_elsewhere, args=(entry, blob()), daemon=True
                 ).start()
                 return entry["return_ids"]
             if self._cluster_size > 1 and not self._can_run_soon(resources):
@@ -469,7 +451,7 @@ class RayletService:
                     target = self.gcs.call("pick_node", resources, [self.node_id])
                     if target is not None:
                         return self._remote(target["sock"]).call(
-                            "submit_task", spec_blob, True
+                            "submit_task", blob(), True
                         )
                 except Exception:
                     pass
@@ -478,6 +460,56 @@ class RayletService:
         self._pending.put(entry)
         self._sched_wake.set()
         return entry["return_ids"]
+
+    def _place_affinity(
+        self, entry: dict, spec_blob: bytes, target_id: str, soft: bool
+    ) -> None:
+        """Resolves + forwards a NodeAffinity task to its target node
+        (background thread; a transient GCS hiccup must neither fail hard
+        affinity permanently nor stall the submit handler)."""
+        info = None
+        looked_up = False
+        for _ in range(3):
+            try:
+                info = self.gcs.call("node_info", target_id)
+                looked_up = True
+                break
+            except Exception:
+                time.sleep(0.3)
+        if info is not None and info.get("alive"):
+            total = info.get("resources") or {}
+            if all(total.get(k, 0.0) >= v for k, v in entry["resources"].items()):
+                try:
+                    self._remote(info["sock"]).call("submit_task", spec_blob, True)
+                    return
+                except Exception:
+                    info = None  # died mid-forward
+            else:
+                # Target can never run it: fail hard affinity here — the
+                # forwarded path skips feasibility.
+                if not soft:
+                    self._store_error_for(
+                        entry,
+                        RuntimeError(
+                            f"hard NodeAffinity to {target_id[:12]}: node "
+                            f"cannot ever satisfy {entry['resources']}"
+                        ),
+                    )
+                    return
+                info = None
+        if not soft:
+            self._store_error_for(
+                entry,
+                RuntimeError(
+                    f"hard NodeAffinity to {target_id[:12]} cannot be satisfied: "
+                    + ("node is gone" if looked_up else "GCS unreachable")
+                ),
+            )
+            return
+        # Soft fallback: re-enter the default placement path.
+        entry = dict(entry)
+        entry["strategy"] = "DEFAULT"
+        self._ingest_entry(entry, None, False)
 
     def _place_elsewhere(self, entry: dict, spec_blob: bytes) -> None:
         """Finds a node for a task this node can never run; retries while
@@ -961,6 +993,20 @@ class RayletService:
         except queue.Empty:
             return {"type": "noop"}
 
+    def worker_step(self, worker_id: str, done: Optional[dict] = None) -> dict:
+        """Combined completion report + next-task poll: the serial worker
+        loop costs ONE RPC per task instead of a done-notify plus a poll
+        (reference: the PushTask reply carrying the result inverts the same
+        two messages into one)."""
+        if done is not None:
+            self.worker_done(
+                worker_id,
+                done.get("ok", True),
+                done.get("sealed"),
+                done.get("task_id"),
+            )
+        return self.worker_poll(worker_id)
+
     def worker_done(
         self,
         worker_id: str,
@@ -1431,6 +1477,10 @@ class RayletService:
 def main(argv: List[str]) -> None:
     node_id, sock_path, store_path, gcs_sock, resources_json, capacity = argv[:6]
     labels = json.loads(argv[6]) if len(argv) > 6 else {}
+
+    from ..utils.sampling_profiler import maybe_start_from_env
+
+    maybe_start_from_env("raylet")
 
     service = RayletService(
         node_id,

@@ -304,6 +304,10 @@ class SharedMemoryStore:
 
     def delete(self, oid: ObjectID) -> bool:
         """Returns True if freed now; False if pinned (caller retries later)."""
+        if self._closed or self._handle < 0:
+            # Interpreter-shutdown ObjectRef finalizers can fire after
+            # close(); a call with a dead handle would index out of bounds.
+            return False
         rc = self._lib.rtpu_delete(self._handle, oid.binary())
         return rc == 0
 

@@ -319,11 +319,16 @@ def main(argv: List[str]) -> None:
 
         pool.submit(run)
 
+    # Serial-path completions piggyback on the next poll (worker_step):
+    # one RPC per task instead of done-notify + poll. Threaded/async actor
+    # paths still report via worker_done from their own threads.
+    step_done: Optional[dict] = None
     while True:
         try:
-            msg = raylet.call("worker_poll", worker_id, timeout=60.0)
+            msg = raylet.call("worker_step", worker_id, step_done, timeout=60.0)
         except Exception:
             return  # raylet gone
+        step_done = None
         kind = msg.get("type")
         if kind == "stop":
             return
@@ -374,7 +379,7 @@ def main(argv: List[str]) -> None:
                 return
             finally:
                 executing_main.clear()
-            done(entry, ok, sealed)
+            step_done = {"ok": ok, "sealed": sealed, "task_id": entry.get("task_id")}
 
 
 if __name__ == "__main__":

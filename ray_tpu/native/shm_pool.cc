@@ -340,9 +340,17 @@ int rtpu_pool_attach(const char* path) {
   return idx;
 }
 
+// Guards every entry point against stale/closed handles: Python finalizers
+// (zero-copy pins, eager ref drops) can run after pool detach, and an
+// unchecked g_pools[-1] is out-of-bounds UB.
+bool rtpu_valid(int handle) {
+  return handle >= 0 && handle < kMaxPools && g_pools[handle].base != nullptr;
+}
+
 // Allocates space for an object. Out: offset of payload from pool base.
 // Returns 0, -EEXIST, -ENOMEM (pool full) or -ENOSPC (table full).
 int rtpu_create(int handle, const uint8_t* key, uint64_t size, uint64_t* out_offset) {
+  if (!rtpu_valid(handle)) return -EINVAL;
   Pool& p = g_pools[handle];
   PoolHeader* h = p.hdr();
   LockGuard g(p);
@@ -362,6 +370,7 @@ int rtpu_create(int handle, const uint8_t* key, uint64_t size, uint64_t* out_off
 }
 
 int rtpu_seal(int handle, const uint8_t* key) {
+  if (!rtpu_valid(handle)) return -EINVAL;
   Pool& p = g_pools[handle];
   PoolHeader* h = p.hdr();
   LockGuard g(p);
@@ -375,6 +384,7 @@ int rtpu_seal(int handle, const uint8_t* key) {
 // Looks up a sealed object and pins it (refcount++). Returns 0, -ENOENT, or
 // -EAGAIN if created but not yet sealed.
 int rtpu_get(int handle, const uint8_t* key, uint64_t* out_offset, uint64_t* out_size) {
+  if (!rtpu_valid(handle)) return -EINVAL;
   Pool& p = g_pools[handle];
   PoolHeader* h = p.hdr();
   LockGuard g(p);
@@ -390,6 +400,7 @@ int rtpu_get(int handle, const uint8_t* key, uint64_t* out_offset, uint64_t* out
 
 // Checks existence without pinning. Returns 1 sealed, 0 in-progress, -ENOENT.
 int rtpu_contains(int handle, const uint8_t* key) {
+  if (!rtpu_valid(handle)) return -EINVAL;
   Pool& p = g_pools[handle];
   PoolHeader* h = p.hdr();
   LockGuard g(p);
@@ -400,6 +411,7 @@ int rtpu_contains(int handle, const uint8_t* key) {
 
 // Unpins a previously gotten object.
 int rtpu_release(int handle, const uint8_t* key) {
+  if (!rtpu_valid(handle)) return -EINVAL;
   Pool& p = g_pools[handle];
   PoolHeader* h = p.hdr();
   LockGuard g(p);
@@ -413,6 +425,7 @@ int rtpu_release(int handle, const uint8_t* key) {
 // delete-on-release semantics are handled by the caller re-invoking delete.
 // Returns 0 freed, -EBUSY still pinned, -ENOENT.
 int rtpu_delete(int handle, const uint8_t* key) {
+  if (!rtpu_valid(handle)) return -EINVAL;
   Pool& p = g_pools[handle];
   PoolHeader* h = p.hdr();
   LockGuard g(p);
@@ -425,9 +438,9 @@ int rtpu_delete(int handle, const uint8_t* key) {
   return 0;
 }
 
-uint64_t rtpu_bytes_in_use(int handle) { return g_pools[handle].hdr()->bytes_in_use; }
-uint64_t rtpu_num_objects(int handle) { return g_pools[handle].hdr()->num_objects; }
-uint64_t rtpu_capacity(int handle) { return g_pools[handle].hdr()->data_size; }
+uint64_t rtpu_bytes_in_use(int handle) { if (!rtpu_valid(handle)) return 0; return g_pools[handle].hdr()->bytes_in_use; }
+uint64_t rtpu_num_objects(int handle) { if (!rtpu_valid(handle)) return 0; return g_pools[handle].hdr()->num_objects; }
+uint64_t rtpu_capacity(int handle) { if (!rtpu_valid(handle)) return 0; return g_pools[handle].hdr()->data_size; }
 
 int rtpu_pool_detach(int handle) {
   if (handle < 0 || handle >= kMaxPools) return -EINVAL;

@@ -115,19 +115,27 @@ def save_aux_state(directory: str, payload: Any) -> None:
     """Pickles host-resident auxiliary training state (optimizer moments,
     RNG keys) alongside a pytree checkpoint. Kept out of save_pytree because
     optax NamedTuple structure does not survive an orbax metadata-restore;
-    a resume must continue the same optimizer trajectory."""
+    a resume must continue the same optimizer trajectory. Written via a
+    temp file + rename so a crash mid-save cannot leave a truncated file."""
     os.makedirs(directory, exist_ok=True)
-    with open(os.path.join(directory, "opt_state.pkl"), "wb") as f:
+    path = os.path.join(directory, "opt_state.pkl")
+    with open(path + ".tmp", "wb") as f:
         pickle.dump(payload, f)
+    os.replace(path + ".tmp", path)
 
 
 def load_aux_state(directory: str) -> Optional[Any]:
-    """Inverse of save_aux_state; None when the checkpoint predates it."""
+    """Inverse of save_aux_state; None when the checkpoint predates it or
+    the sidecar is unreadable (callers fall back to fresh optimizer state —
+    an intact params pytree must stay restorable)."""
     path = os.path.join(directory, "opt_state.pkl")
     if not os.path.exists(path):
         return None
-    with open(path, "rb") as f:
-        return pickle.load(f)
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except Exception:
+        return None
 
 
 @dataclasses.dataclass

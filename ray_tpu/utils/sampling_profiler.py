@@ -1,0 +1,92 @@
+"""Statistical sampling profiler for framework daemons.
+
+cProfile only observes the thread that enables it, which is useless for the
+raylet/GCS whose work happens on RPC server threads. This sampler walks
+``sys._current_frames()`` on an interval and aggregates truncated stacks —
+the same approach as external samplers (py-spy) but in-process and
+dependency-free. Enable per-daemon with RAY_TPU_SAMPLING_PROFILE=<dir>;
+each process writes <dir>/<name>-<pid>.txt at exit, hottest stacks first.
+(reference: the reference ships cProfile/py-spy hooks via
+ray._private.profiling and the dashboard's flame-graph endpoint.)
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import os
+import sys
+import threading
+from typing import Optional
+
+_DEPTH = 5
+
+
+class SamplingProfiler:
+    def __init__(self, interval_s: float = 0.002, depth: int = _DEPTH):
+        self.interval_s = interval_s
+        self.depth = depth
+        self.counts: collections.Counter = collections.Counter()
+        self.samples = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._path: Optional[str] = None
+
+    def start(self) -> "SamplingProfiler":
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="sampler")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        me = threading.get_ident()
+        last_dump = 0.0
+        import time
+
+        while not self._stop.wait(self.interval_s):
+            self.samples += 1
+            for tid, frame in list(sys._current_frames().items()):
+                if tid == me:
+                    continue
+                stack = []
+                f = frame
+                for _ in range(self.depth):
+                    if f is None:
+                        break
+                    code = f.f_code
+                    stack.append(
+                        f"{os.path.basename(code.co_filename)}:{code.co_firstlineno}:{code.co_name}"
+                    )
+                    f = f.f_back
+                self.counts[" < ".join(stack)] += 1
+            # Periodic dump: daemons are SIGTERMed on cluster teardown, so
+            # an atexit-only dump races process kill.
+            if self._path and time.monotonic() - last_dump > 2.0:
+                last_dump = time.monotonic()
+                try:
+                    self.dump(self._path)
+                except OSError:
+                    pass
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(f"# samples={self.samples} interval={self.interval_s}s\n")
+            for stack, n in self.counts.most_common(100):
+                f.write(f"{n}\t{stack}\n")
+
+
+def maybe_start_from_env(name: str) -> Optional[SamplingProfiler]:
+    """Starts a sampler when RAY_TPU_SAMPLING_PROFILE is set to a directory;
+    dumps to <dir>/<name>-<pid>.txt at process exit."""
+    out_dir = os.environ.get("RAY_TPU_SAMPLING_PROFILE")
+    if not out_dir:
+        return None
+    os.makedirs(out_dir, exist_ok=True)
+    prof = SamplingProfiler()
+    path = os.path.join(out_dir, f"{name}-{os.getpid()}.txt")
+    prof._path = path
+    prof.start()
+    atexit.register(lambda: (prof.stop(), prof.dump(path)))
+    return prof
