@@ -839,6 +839,34 @@ def _session_alive(session_dir: str) -> bool:
         s.close()
 
 
+def _spawn_logged_cmd(log_dir: str, name: str, cmd: List[str]) -> subprocess.Popen:
+    """Spawns a daemon with stdout/stderr captured under the session's log
+    dir (reference: session_latest/logs; DEVNULLing them made any daemon
+    crash undiagnosable)."""
+    out = open(os.path.join(log_dir, f"{name}.out"), "ab", buffering=0)
+    err = open(os.path.join(log_dir, f"{name}.err"), "ab", buffering=0)
+    try:
+        return subprocess.Popen(cmd, stdout=out, stderr=err)
+    finally:
+        out.close()
+        err.close()
+
+
+def _pick_store_path(session_dir: str, node_id: str, capacity: int, claimed: int = 0) -> str:
+    """Object-pool file placement: tmpfs when it fits (like plasma's
+    /dev/shm default — a disk-backed mmap caps put() at disk writeback
+    speed), else the session dir. Pool files are sparse, so statvfs alone
+    would let every node pass the same check; `claimed` counts capacity
+    already promised to this cluster's earlier stores (overcommit ->
+    SIGBUS)."""
+    path = os.path.join(session_dir, f"store_{node_id}")
+    if os.path.isdir("/dev/shm"):
+        st = os.statvfs("/dev/shm")
+        if st.f_bavail * st.f_frsize - claimed > capacity * 1.1:
+            path = f"/dev/shm/rtpu_{os.path.basename(session_dir)}_{node_id}"
+    return path
+
+
 def _sweep_orphaned_pools() -> None:
     """Unlinks /dev/shm pools (and session dirs) of dead sessions: a
     SIGKILLed driver never runs atexit, and tmpfs pages would otherwise
@@ -878,7 +906,14 @@ class Cluster:
         resources: Optional[Dict[str, float]] = None,
         object_store_memory: Optional[int] = None,
         num_workers: Optional[int] = None,
+        head_port: Optional[int] = None,
+        node_ip: str = "127.0.0.1",
     ):
+        """head_port enables multi-host mode: the GCS additionally listens
+        on tcp://node_ip:head_port (0 = ephemeral) and every raylet serves
+        + advertises a TCP endpoint, so raylets started on OTHER hosts
+        (`start_worker_node`, `ray-tpu start --address`) can join
+        (reference: `ray start --head --port` bootstrapping)."""
         from ..utils.config import CONFIG
 
         _sweep_orphaned_pools()
@@ -889,17 +924,27 @@ class Cluster:
         self._store_paths: Dict[str, str] = {}
         self._shm_claimed = 0
         self._store_capacity = int(object_store_memory or CONFIG.object_store_memory)
+        self._node_ip = node_ip
+        self._tcp_mode = head_port is not None
 
         self.log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(self.log_dir, exist_ok=True)
         self.gcs_snapshot = os.path.join(self.session_dir, "gcs_state.pkl")
-        gcs_proc = self._spawn_logged(
-            [sys.executable, "-m", "ray_tpu.core.gcs", self.gcs_sock, self.gcs_snapshot],
-            "gcs",
-        )
+        self._gcs_cmd = [sys.executable, "-m", "ray_tpu.core.gcs", self.gcs_sock, self.gcs_snapshot]
+        if self._tcp_mode:
+            self._gcs_cmd.append(f"tcp://{node_ip}:{head_port}")
+        gcs_proc = self._spawn_logged(self._gcs_cmd, "gcs")
         self._procs.append(gcs_proc)
         self._gcs_proc = gcs_proc
         RpcClient(self.gcs_sock).call("ping")  # wait for boot
+        self.gcs_tcp_address: Optional[str] = (
+            self._read_announced("gcs.out", "GCS_TCP_ADDRESS=") if self._tcp_mode else None
+        )
+        if self._tcp_mode:
+            # Pin the resolved port into the respawn command: restart_gcs
+            # must come back on the address already advertised to joiners
+            # (an ephemeral :0 would re-roll).
+            self._gcs_cmd[-1] = self.gcs_tcp_address
 
         head_res = dict(resources or {})
         head_res.setdefault("CPU", float(num_cpus if num_cpus is not None else os.cpu_count() or 1))
@@ -908,6 +953,7 @@ class Cluster:
         self.head_node_id = self.add_node(resources=head_res, num_workers=num_workers)
         info = {
             "gcs_sock": self.gcs_sock,
+            "gcs_tcp_address": self.gcs_tcp_address,
             "head_raylet_sock": self._sock_for(self.head_node_id),
             "head_store": self._store_for(self.head_node_id),
             "head_node_id": self.head_node_id,
@@ -916,36 +962,36 @@ class Cluster:
             json.dump(info, f)
         atexit.register(self._cleanup)
 
+    def _read_announced(self, log_name: str, prefix: str, timeout: float = 10.0) -> str:
+        """Reads a KEY=value announcement a daemon printed to its log
+        (ephemeral ports are only known after bind)."""
+        path = os.path.join(self.log_dir, log_name)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                with open(path) as f:
+                    for line in f:
+                        if line.startswith(prefix):
+                            return line[len(prefix):].strip()
+            except OSError:
+                pass
+            time.sleep(0.05)
+        raise RuntimeError(f"daemon never announced {prefix} in {log_name}")
+
     def _spawn_logged(self, cmd: List[str], name: str) -> subprocess.Popen:
-        """Daemon stdout/stderr captured under <session>/logs (reference:
-        session_latest/logs in the reference; DEVNULLing them made any
-        daemon crash undiagnosable)."""
-        out = open(os.path.join(self.log_dir, f"{name}.out"), "ab", buffering=0)
-        err = open(os.path.join(self.log_dir, f"{name}.err"), "ab", buffering=0)
-        try:
-            return subprocess.Popen(cmd, stdout=out, stderr=err)
-        finally:
-            out.close()
-            err.close()
+        return _spawn_logged_cmd(self.log_dir, name, cmd)
 
     def _sock_for(self, node_id: str) -> str:
         return os.path.join(self.session_dir, f"raylet_{node_id}.sock")
 
     def _store_for(self, node_id: str) -> str:
-        # The pool lives on tmpfs when available (like plasma's /dev/shm
-        # default): a disk-backed mmap caps put() at disk writeback speed.
         path = self._store_paths.get(node_id)
         if path is None:
-            path = os.path.join(self.session_dir, f"store_{node_id}")
-            if os.path.isdir("/dev/shm"):
-                st = os.statvfs("/dev/shm")
-                # Pool files are sparse, so statvfs alone would let every
-                # node pass the same check; count capacity already claimed
-                # by this cluster's earlier stores (overcommit -> SIGBUS).
-                free = st.f_bavail * st.f_frsize - self._shm_claimed
-                if free > self._store_capacity * 1.1:
-                    path = f"/dev/shm/rtpu_{os.path.basename(self.session_dir)}_{node_id}"
-                    self._shm_claimed += self._store_capacity
+            path = _pick_store_path(
+                self.session_dir, node_id, self._store_capacity, self._shm_claimed
+            )
+            if path.startswith("/dev/shm/"):
+                self._shm_claimed += self._store_capacity
             self._store_paths[node_id] = path
         return path
 
@@ -962,21 +1008,21 @@ class Cluster:
         if num_cpus is not None:
             res["CPU"] = float(num_cpus)
         res.setdefault("CPU", 1.0)
-        proc = self._spawn_logged(
-            [
-                sys.executable,
-                "-m",
-                "ray_tpu.core.raylet",
-                node_id,
-                self._sock_for(node_id),
-                self._store_for(node_id),
-                self.gcs_sock,
-                json.dumps(res),
-                str(self._store_capacity),
-                json.dumps(labels or {}),
-            ],
-            f"raylet_{node_id}",
-        )
+        cmd = [
+            sys.executable,
+            "-m",
+            "ray_tpu.core.raylet",
+            node_id,
+            self._sock_for(node_id),
+            self._store_for(node_id),
+            self.gcs_sock,
+            json.dumps(res),
+            str(self._store_capacity),
+            json.dumps(labels or {}),
+        ]
+        if self._tcp_mode:
+            cmd.append(f"tcp://{self._node_ip}:0")
+        proc = self._spawn_logged(cmd, f"raylet_{node_id}")
         self._procs.append(proc)
         self._node_procs[node_id] = proc
         RpcClient(self._sock_for(node_id)).call("ping")
@@ -989,10 +1035,10 @@ class Cluster:
         self._gcs_proc.kill()
         self._gcs_proc.wait(timeout=5.0)
         self._procs.remove(self._gcs_proc)
-        self._gcs_proc = self._spawn_logged(
-            [sys.executable, "-m", "ray_tpu.core.gcs", self.gcs_sock, self.gcs_snapshot],
-            "gcs",
-        )
+        # Same command as the original spawn: in multi-host mode the tcp://
+        # endpoint must come back on the SAME port or joined hosts are
+        # orphaned (their clients reconnect to the advertised address).
+        self._gcs_proc = self._spawn_logged(self._gcs_cmd, "gcs")
         self._procs.append(self._gcs_proc)
         RpcClient(self.gcs_sock).call("ping")
 
@@ -1032,3 +1078,67 @@ class Cluster:
 
     def shutdown(self):
         self._cleanup()
+
+
+def start_worker_node(
+    gcs_address: str,
+    node_ip: Optional[str] = None,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    object_store_memory: Optional[int] = None,
+    labels: Optional[Dict[str, Any]] = None,
+) -> dict:
+    """Starts a raylet on THIS host that joins a remote GCS over TCP
+    (reference: `ray start --address=head:port` worker-node bootstrap).
+    The raylet serves local workers over a UDS in its own session dir,
+    advertises tcp://node_ip:<ephemeral> to the cluster, and hosts its own
+    shm object pool. When node_ip is omitted it is derived from the route
+    to the GCS (the local address of a socket connected to it) — the ip
+    the head can dial back. Returns {node_id, session_dir, sock, proc}."""
+    import socket as _socket
+
+    from ..utils.config import CONFIG
+    from .rpc import parse_address
+
+    kind, target = parse_address(gcs_address)
+    if kind != "tcp":
+        raise ValueError("gcs_address must be tcp://host:port (the head's GCS endpoint)")
+    if node_ip is None:
+        probe = _socket.create_connection(target, timeout=10.0)
+        try:
+            node_ip = probe.getsockname()[0]
+        finally:
+            probe.close()
+    session_dir = tempfile.mkdtemp(prefix="ray_tpu_worker_")
+    log_dir = os.path.join(session_dir, "logs")
+    os.makedirs(log_dir, exist_ok=True)
+    node_id = uuid.uuid4().hex[:12]
+    res = dict(resources or {})
+    if num_cpus is not None:
+        res["CPU"] = float(num_cpus)
+    res.setdefault("CPU", float(os.cpu_count() or 1))
+    if num_tpus:
+        res.setdefault("TPU", float(num_tpus))
+    capacity = int(object_store_memory or CONFIG.object_store_memory)
+    store = _pick_store_path(session_dir, node_id, capacity)
+    sock = os.path.join(session_dir, f"raylet_{node_id}.sock")
+    proc = _spawn_logged_cmd(
+        log_dir,
+        "raylet",
+        [
+            sys.executable,
+            "-m",
+            "ray_tpu.core.raylet",
+            node_id,
+            sock,
+            store,
+            gcs_address,
+            json.dumps(res),
+            str(capacity),
+            json.dumps(labels or {}),
+            f"tcp://{node_ip}:0",
+        ],
+    )
+    RpcClient(sock).call("ping")
+    return {"node_id": node_id, "session_dir": session_dir, "sock": sock, "proc": proc}

@@ -1066,17 +1066,35 @@ class GcsService:
         return True
 
 
-def main(sock_path: str, snapshot_path: Optional[str] = None) -> None:
+def main(
+    sock_path: str,
+    snapshot_path: Optional[str] = None,
+    tcp_address: Optional[str] = None,
+) -> None:
+    """GCS daemon. Serves the local UDS always; with `tcp_address`
+    (tcp://host:port) ALSO serves the same tables over TCP so raylets on
+    OTHER hosts can join (reference: the GCS listens on --gcs-server-port
+    for the whole cluster)."""
     from .rpc import RpcServer
 
     service = GcsService(snapshot_path=snapshot_path or sock_path + ".snapshot")
     server = RpcServer(sock_path, service)
+    tcp_server = RpcServer(tcp_address, service) if tcp_address else None
+    if tcp_server is not None:
+        # The bound address (ephemeral ports resolved) for the bootstrapper.
+        print(f"GCS_TCP_ADDRESS={tcp_server.address}", flush=True)
     try:
         while not service._stop.wait(0.5):
             pass
     finally:
+        if tcp_server is not None:
+            tcp_server.shutdown()
         server.shutdown()
 
 
 if __name__ == "__main__":
-    main(sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else None)
+    main(
+        sys.argv[1],
+        sys.argv[2] if len(sys.argv) > 2 else None,
+        sys.argv[3] if len(sys.argv) > 3 else None,
+    )

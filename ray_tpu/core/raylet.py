@@ -61,9 +61,14 @@ class RayletService:
         resources: Dict[str, float],
         store_capacity: int,
         labels: Optional[Dict[str, Any]] = None,
+        advertise_address: Optional[str] = None,
     ):
         self.node_id = node_id
         self.sock_path = sock_path
+        # The address other NODES reach this raylet at. Defaults to the
+        # local UDS (single-host cluster); a multi-host raylet advertises
+        # its tcp:// endpoint while local workers keep the UDS.
+        self.advertised = advertise_address or sock_path
         self.store_path = store_path
         self.store = SharedMemoryStore.create(store_path, store_capacity)
         self.gcs = RpcClient(gcs_sock)
@@ -149,7 +154,7 @@ class RayletService:
             threading.Thread(target=self._flush_loop, daemon=True, name="flush"),
         ]
         reg = self.gcs.call(
-            "register_node", node_id, sock_path, store_path, resources, self.labels
+            "register_node", node_id, self.advertised, store_path, resources, self.labels
         )
         self._cluster_size = reg.get("nodes", 1) if isinstance(reg, dict) else 1
         for t in self._threads:
@@ -1446,7 +1451,7 @@ class RayletService:
                         self.gcs.call(
                             "register_node",
                             self.node_id,
-                            self.sock_path,
+                            self.advertised,
                             self.store_path,
                             self.total,
                             self.labels,
@@ -1477,11 +1482,17 @@ class RayletService:
 def main(argv: List[str]) -> None:
     node_id, sock_path, store_path, gcs_sock, resources_json, capacity = argv[:6]
     labels = json.loads(argv[6]) if len(argv) > 6 else {}
+    tcp_spec = argv[7] if len(argv) > 7 and argv[7] else None
 
     from ..utils.sampling_profiler import maybe_start_from_env
 
     maybe_start_from_env("raylet")
 
+    # Multi-host mode: pre-bind the TCP endpoint (resolving an ephemeral
+    # port) so the service can advertise it at registration; the service
+    # object attaches right after construction (the RPC server holds early
+    # connections until then). Local workers keep the UDS.
+    tcp_server = RpcServer(tcp_spec, None) if tcp_spec else None
     service = RayletService(
         node_id,
         sock_path,
@@ -1490,12 +1501,18 @@ def main(argv: List[str]) -> None:
         json.loads(resources_json),
         int(capacity),
         labels=labels,
+        advertise_address=tcp_server.address if tcp_server else None,
     )
+    if tcp_server is not None:
+        tcp_server.service = service
+        print(f"RAYLET_TCP_ADDRESS={tcp_server.address}", flush=True)
     server = RpcServer(sock_path, service)
     try:
         while not service._stop.wait(0.5):
             pass
     finally:
+        if tcp_server is not None:
+            tcp_server.shutdown()
         server.shutdown()
 
 

@@ -1,12 +1,13 @@
-"""Minimal UDS RPC: length-prefixed pickle messages, threaded server.
+"""Minimal dual-transport RPC: length-prefixed pickle messages, threaded server.
 
 Stands in for the reference's gRPC layer (reference: src/ray/rpc/ — gRPC
 client/server wrappers). Same shape: named handler methods on a service
-object, request/reply with correlation ids, a retrying client. Unix domain
-sockets because all nodes of the simulated cluster share one machine (the
-reference's Cluster fixture runs multiple raylets on one host the same
-way, python/ray/cluster_utils.py:135); swapping the transport for TCP is a
-address-string change.
+object, request/reply with correlation ids, a retrying client. Two
+transports behind one address-string scheme: plain paths are Unix domain
+sockets (node-local traffic: workers <-> raylet, same-host daemons, like
+the reference's local gRPC over loopback), `tcp://host:port` is TCP with
+TCP_NODELAY for the cross-host control plane (GCS <-> remote raylets,
+raylet <-> raylet object transfer on a multi-host cluster).
 """
 
 from __future__ import annotations
@@ -19,9 +20,25 @@ import struct
 import threading
 import time
 import uuid
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 _HDR = struct.Struct("<I")
+# First frame of an authenticated TCP connection: RTPUAUTH:<token>.
+# The control plane speaks pickle, so an open TCP port is arbitrary code
+# execution for anyone who can reach it (the reference has the same
+# property and warns to never expose Ray ports to untrusted networks);
+# RAY_TPU_AUTH_TOKEN gates connections with a shared secret.
+_AUTH_PREFIX = b"RTPUAUTH:"
+
+
+def parse_address(addr: str) -> Tuple[str, Any]:
+    """Returns ("tcp", (host, port)) or ("uds", path)."""
+    if addr.startswith("tcp://"):
+        host, sep, port = addr[6:].rpartition(":")
+        if not sep or not host or not port.isdigit():
+            raise ValueError(f"tcp address must be tcp://host:port, got {addr!r}")
+        return "tcp", (host, int(port))
+    return "uds", addr
 
 
 def _send_msg(sock: socket.socket, payload: bytes) -> None:
@@ -52,19 +69,58 @@ class RpcServer:
     def __init__(self, path: str, service: Any):
         self.path = path
         self.service = service
-        if os.path.exists(path):
+        self._kind, target = parse_address(path)
+        self._auth = os.environ.get("RAY_TPU_AUTH_TOKEN") or None
+        if self._kind == "uds" and os.path.exists(path):
             os.unlink(path)
+        if self._kind == "tcp" and not self._auth:
+            print(
+                "ray_tpu: serving the control plane on TCP without "
+                "RAY_TPU_AUTH_TOKEN — anyone who can reach this port can "
+                "execute code as this user; only use on trusted networks.",
+                file=__import__("sys").stderr,
+                flush=True,
+            )
 
         server_self = self
 
         class Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                try:  # latency: a request/reply protocol must not Nagle
+                    self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass  # UDS has no TCP options
+
             def handle(self):
                 sock = self.request
+                # A server may be pre-bound before its service exists (a
+                # raylet binds its TCP port to learn the ephemeral port it
+                # advertises, then constructs the service): hold early
+                # connections until the service attaches.
+                while server_self.service is None:
+                    time.sleep(0.005)
+                if server_self._kind == "tcp" and server_self._auth:
+                    import hmac as _hmac
+
+                    try:
+                        first = _recv_msg(sock)
+                    except (ConnectionError, OSError):
+                        return
+                    if not (
+                        first.startswith(_AUTH_PREFIX)
+                        and _hmac.compare_digest(
+                            first[len(_AUTH_PREFIX):],
+                            server_self._auth.encode(),
+                        )
+                    ):
+                        return  # drop unauthenticated connections
                 while True:
                     try:
                         raw = _recv_msg(sock)
                     except (ConnectionError, OSError):
                         return
+                    if raw.startswith(_AUTH_PREFIX):
+                        continue  # tolerated when this server needs no auth
                     req_id, method, args, kwargs = pickle.loads(raw)
                     if req_id is None:
                         # One-way notification: execute without replying
@@ -89,11 +145,27 @@ class RpcServer:
                     except (ConnectionError, OSError):
                         return
 
-        class Server(socketserver.ThreadingUnixStreamServer):
-            daemon_threads = True
-            allow_reuse_address = True
+        if self._kind == "tcp":
 
-        self._server = Server(path, Handler)
+            class Server(socketserver.ThreadingTCPServer):
+                daemon_threads = True
+                allow_reuse_address = True
+
+            self._server = Server(target, Handler)
+            host, port = self._server.server_address[:2]
+            # Canonical reachable address (resolves port 0 -> the bound
+            # ephemeral port; a wildcard bind is advertised as loopback,
+            # callers that need a routable ip pass it explicitly).
+            adv = target[0] if target[0] not in ("", "0.0.0.0", "::") else "127.0.0.1"
+            self.address = f"tcp://{adv}:{port}"
+        else:
+
+            class Server(socketserver.ThreadingUnixStreamServer):
+                daemon_threads = True
+                allow_reuse_address = True
+
+            self._server = Server(target, Handler)
+            self.address = path
         self._thread = threading.Thread(
             target=self._server.serve_forever, name=f"rpc-{os.path.basename(path)}", daemon=True
         )
@@ -102,10 +174,11 @@ class RpcServer:
     def shutdown(self):
         self._server.shutdown()
         self._server.server_close()
-        try:
-            os.unlink(self.path)
-        except OSError:
-            pass
+        if self._kind == "uds":
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
 
 
 class RpcClient:
@@ -125,12 +198,21 @@ class RpcClient:
         self._get_sock()
 
     def _new_sock(self, timeout: float) -> socket.socket:
+        kind, target = parse_address(self.path)
         deadline = time.monotonic() + timeout
         last_err: Optional[Exception] = None
         while time.monotonic() < deadline:
             try:
-                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                s.connect(self.path)
+                if kind == "tcp":
+                    s = socket.create_connection(target, timeout=10.0)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    s.settimeout(None)
+                    token = os.environ.get("RAY_TPU_AUTH_TOKEN")
+                    if token:
+                        _send_msg(s, _AUTH_PREFIX + token.encode())
+                else:
+                    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                    s.connect(target)
                 with self._all_lock:
                     self._all.append(s)
                 return s

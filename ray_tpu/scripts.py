@@ -38,14 +38,36 @@ def _resolve_address(args) -> str:
 def cmd_start(args) -> None:
     import atexit
 
-    from .core.cluster_runtime import Cluster
+    from .core.cluster_runtime import Cluster, start_worker_node
 
     resources = json.loads(args.resources) if args.resources else None
+    if args.address:
+        # Worker-node mode (reference: `ray start --address=head:port`).
+        info = start_worker_node(
+            args.address,
+            node_ip=args.node_ip_address,
+            num_cpus=args.num_cpus,
+            num_tpus=args.num_tpus,
+            resources=resources,
+            object_store_memory=args.object_store_memory,
+        )
+        with open(os.path.join(info["session_dir"], "pids.json"), "w") as f:
+            json.dump([info["proc"].pid], f)
+        # Per-host stop semantics (like `ray stop`): `ray-tpu stop` on this
+        # host finds and kills this raylet.
+        _record_session(info["session_dir"])
+        print(
+            f"joined cluster at {args.address}; node {info['node_id']} "
+            f"(session dir: {info['session_dir']})"
+        )
+        return
     cluster = Cluster(
         num_cpus=args.num_cpus,
         num_tpus=args.num_tpus,
         resources=resources,
         object_store_memory=args.object_store_memory,
+        head_port=args.port,
+        node_ip=args.node_ip_address or "127.0.0.1",
     )
     # The daemons must outlive this CLI process (reference: `ray start`
     # leaves raylets running): drop the kill-children atexit hook.
@@ -56,6 +78,10 @@ def cmd_start(args) -> None:
     _record_session(cluster.session_dir)
     print(f"started cluster; session dir: {cluster.session_dir}")
     print(f"connect with: ray_tpu.init(address={cluster.session_dir!r})")
+    if cluster.gcs_tcp_address:
+        print(
+            f"other hosts join with: ray-tpu start --address {cluster.gcs_tcp_address}"
+        )
 
 
 def cmd_stop(args) -> None:
@@ -187,11 +213,29 @@ def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="ray-tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
-    p = sub.add_parser("start", help="start a local cluster head")
+    p = sub.add_parser("start", help="start a cluster head (or join one with --address)")
     p.add_argument("--num-cpus", type=float, default=None)
     p.add_argument("--num-tpus", type=float, default=None)
     p.add_argument("--resources", default=None, help="JSON dict of custom resources")
     p.add_argument("--object-store-memory", type=int, default=None)
+    p.add_argument(
+        "--port",
+        type=int,
+        default=None,
+        help="also serve the GCS on tcp://<node-ip>:<port> so other hosts can join (0 = ephemeral)",
+    )
+    p.add_argument(
+        "--node-ip-address",
+        default=None,
+        help="routable ip this host advertises to the cluster "
+        "(default: 127.0.0.1 for a head; derived from the route to the "
+        "GCS when joining with --address)",
+    )
+    p.add_argument(
+        "--address",
+        default=None,
+        help="join an existing cluster: the head's tcp://host:port GCS endpoint",
+    )
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("stop", help="stop the cluster")
