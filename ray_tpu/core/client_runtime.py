@@ -1,0 +1,113 @@
+"""Remote-client driver: attach to a cluster from any machine over TCP.
+
+Re-design of the reference's ray client (reference: python/ray/util/client/
+— a gRPC proxy mode where a driver outside the cluster tunnels its API
+calls through a server-side proxy; proto src/ray/protobuf/ray_client.proto).
+Here the client IS a ClusterRuntime minus the node-local pieces: control
+RPCs (GCS, raylet) already ride the dual-transport RPC layer, so only the
+OBJECT plane needs proxying — puts/gets go through a gateway raylet
+(`client_put`/`client_get`) instead of a locally-mmapped pool. Ownership,
+reference counting, task records, and retries all run client-side exactly
+as on a driver inside the cluster.
+
+Usage: ``ray_tpu.init(address="tcp://head:port")`` where the cluster head
+was started with a TCP port (`ray-tpu start --port N`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from .. import exceptions as exc
+from . import serialization
+from .cluster_runtime import ClusterRuntime
+from .ids import ObjectID
+from .object_transport import StoredError
+from .rpc import RpcClient
+
+
+class _RemoteStoreProxy:
+    """The subset of the shm-store surface ClusterRuntime touches, proxied
+    through the gateway raylet. No zero-copy (values cross the network),
+    no local eviction concerns."""
+
+    def __init__(self, raylet: RpcClient):
+        self._raylet = raylet
+
+    # -- writes ----------------------------------------------------------
+    def put(self, oid: ObjectID, value: Any) -> None:
+        blob = serialization.pack(value)
+        self._raylet.call("client_put", oid.hex(), blob)
+
+    def put_with_pressure(self, oid, value, raylet, deadline_s=15.0, pre_pressure=None):
+        # Pool pressure is handled server-side by client_put itself.
+        self.put(oid, value)
+
+    # -- reads -----------------------------------------------------------
+    def get(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
+        raw = self._raylet.call(
+            "client_get", oid.hex(), timeout or 0.0, timeout=(timeout or 0.0) + 15.0
+        )
+        if raw is None:
+            raise KeyError(oid.hex())
+        return serialization.unpack(raw)
+
+    def contains(self, oid: ObjectID) -> bool:
+        return False  # client holds nothing locally; get() always proxies
+
+    # -- lifecycle / accounting (meaningless off-node) -------------------
+    def delete(self, oid: ObjectID) -> bool:
+        return False  # frees ride the GCS path; no eager local delete
+
+    def bytes_in_use(self) -> int:
+        return 0
+
+    def num_objects(self) -> int:
+        return 0
+
+    def capacity(self) -> int:
+        return 0
+
+    def close(self) -> None:
+        pass
+
+
+class ClientRuntime(ClusterRuntime):
+    """A driver outside the cluster. Everything except the object plane is
+    inherited: submissions are one-way notifies to the gateway raylet,
+    actor routing resolves socks from the GCS (tcp:// in multi-host
+    clusters), refcounting/borrows flow to the GCS as usual."""
+
+    @classmethod
+    def connect_tcp(cls, gcs_address: str) -> "ClientRuntime":
+        gcs = RpcClient(gcs_address)
+        nodes = [n for n in gcs.call("list_nodes") if n.get("Alive")]
+        if not nodes:
+            raise RuntimeError(f"no alive nodes behind {gcs_address}")
+        # Gateway: a raylet the client can reach. In multi-host mode every
+        # raylet advertises tcp://; UDS socks only work for a same-host
+        # client (still valid — e.g. attaching by GCS port locally).
+        gw = next(
+            (n for n in nodes if str(n["sock"]).startswith("tcp://")), nodes[0]
+        )
+        raylet = RpcClient(gw["sock"])
+        return cls(gcs, raylet, _RemoteStoreProxy(raylet), gw["NodeID"], driver=True)
+
+    # Object fetch: one proxied RPC replaces the local-store wait loop.
+    def _get_one(self, oid: ObjectID, deadline: Optional[float]) -> Any:
+        h = oid.hex()
+        while True:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise exc.GetTimeoutError(f"get() timed out for {h[:12]}")
+            window = 5.0 if remaining is None else max(0.05, min(5.0, remaining))
+            raw = self._raylet.call("client_get", h, window, timeout=window + 15.0)
+            if raw is not None:
+                value = serialization.unpack(raw)
+                if isinstance(value, StoredError):
+                    raise value.error
+                return value
+            # Nothing within the window: consult the task table for
+            # failure/loss; retries resubmit through the gateway.
+            self._maybe_recover(oid)

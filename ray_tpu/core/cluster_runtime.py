@@ -143,15 +143,22 @@ class ClusterRuntime(Runtime):
         threading.Thread(target=self._submit_loop, daemon=True, name="submit").start()
         # Stream worker stdout/stderr to the driver console (reference:
         # log_monitor.py tailing worker logs to the driver; disable with
-        # RAY_TPU_LOG_TO_DRIVER=0).
-        if driver and os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+        # RAY_TPU_LOG_TO_DRIVER=0). Remote clients (tcp:// raylet, no
+        # session dir) have no local log files to tail — skip the thread.
+        self._log_session = session_dir or (
+            None if raylet.path.startswith("tcp://") else os.path.dirname(raylet.path)
+        )
+        if (
+            driver
+            and self._log_session
+            and os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0"
+        ):
             threading.Thread(
                 target=self._stream_logs, daemon=True, name="logmon"
             ).start()
 
     def _stream_logs(self) -> None:
-        session = self._session_dir or os.path.dirname(self._raylet.path)
-        log_dir = os.path.join(session, "logs")
+        log_dir = os.path.join(self._log_session, "logs")
         offsets: Dict[str, int] = {}
         # Stream only output produced AFTER this driver attached: replaying
         # a long-lived cluster's history (or other jobs' output) floods the
@@ -211,7 +218,14 @@ class ClusterRuntime(Runtime):
         object_store_memory: Optional[int] = None,
         num_workers: Optional[int] = None,
     ) -> "ClusterRuntime":
-        if address:
+        if address and address.startswith("tcp://"):
+            # Remote-client mode (reference: ray client, util/client/):
+            # a driver outside the cluster attaching by the head's TCP
+            # address; object ops proxy through a gateway raylet.
+            from .client_runtime import ClientRuntime
+
+            rt = ClientRuntime.connect_tcp(address)
+        elif address:
             rt = cls.connect(address)
         else:
             cluster = Cluster(
@@ -822,12 +836,24 @@ class ClusterRuntime(Runtime):
 
 
 def _session_alive(session_dir: str) -> bool:
-    """A session is alive iff its GCS socket accepts a connection."""
+    """A session is alive iff one of its daemon sockets accepts a
+    connection: gcs.sock for a head session, raylet_*.sock for a
+    worker-node session created by start_worker_node (which has no GCS —
+    sweeping those by gcs.sock absence would destroy a LIVE joined node's
+    pool and socket)."""
+    import glob as _glob
+
+    candidates = [os.path.join(session_dir, "gcs.sock")]
+    candidates += _glob.glob(os.path.join(session_dir, "raylet_*.sock"))
+    for sock_path in candidates:
+        if os.path.exists(sock_path) and _uds_accepts(sock_path):
+            return True
+    return False
+
+
+def _uds_accepts(sock_path: str) -> bool:
     import socket
 
-    sock_path = os.path.join(session_dir, "gcs.sock")
-    if not os.path.exists(sock_path):
-        return False
     s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     s.settimeout(0.2)
     try:

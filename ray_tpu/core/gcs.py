@@ -470,23 +470,35 @@ class GcsService:
             else:
                 node = self._place_with_strategy(resources, strategy)
                 if node is None and not _is_hard_affinity(strategy):
-                    # The resource view lags a heartbeat behind a task burst:
-                    # give it a couple of periods to catch up before refusing.
-                    # (Hard affinity is a totals-based static check — waiting
-                    # cannot change the answer.)
-                    deadline = time.monotonic() + 3 * CONFIG.heartbeat_interval_s
-                    while time.monotonic() <= deadline:
-                        node = self._place_with_strategy(resources, strategy)
-                        if node is not None:
-                            break
-                        time.sleep(0.1)
+                    # Busy cluster: fall back to a node whose TOTAL capacity
+                    # fits — the raylet queues the creation until resources
+                    # free, matching the reference's PENDING_CREATION state
+                    # (gcs_actor_scheduler queues actors; it never fails
+                    # them for transient load). Round-robin over the
+                    # feasible nodes so a burst of overflow actors spreads
+                    # its queues instead of piling onto one node.
+                    with self._lock:
+                        feasible = [
+                            {"node_id": nid, "sock": n["sock"], "store": n["store"]}
+                            for nid, n in sorted(self._nodes.items())
+                            if n["alive"]
+                            and all(
+                                n["resources"].get(k, 0.0) >= v
+                                for k, v in resources.items()
+                            )
+                        ]
+                        if feasible:
+                            self._overflow_rr = getattr(self, "_overflow_rr", -1) + 1
+                            node = feasible[self._overflow_rr % len(feasible)]
                 if node is None:
                     if _is_hard_affinity(strategy):
                         raise RuntimeError(
                             f"hard NodeAffinity to {strategy.split(':')[1][:12]} "
                             f"cannot be satisfied for actor requiring {resources}"
                         )
-                    raise RuntimeError(f"no node can host actor requiring {resources}")
+                    raise RuntimeError(
+                        f"no node can EVER host actor requiring {resources}"
+                    )
         except BaseException:
             if key is not None:
                 with self._lock:

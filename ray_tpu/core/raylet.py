@@ -644,6 +644,40 @@ class RayletService:
         return True
 
     # ------------------------------------------------------- object plane
+    # -------------------------------------------------- remote-client proxy
+    def client_put(self, oid_hex: str, blob: bytes) -> bool:
+        """Stores a pre-framed object on behalf of a remote client driver
+        (reference: ray client's server-side proxy owning client objects,
+        util/client/server/). This raylet's node becomes the primary."""
+        oid = ObjectID.from_hex(oid_hex)
+        try:
+            self.store.put_raw(oid, blob)
+        except exc.ObjectStoreFullError:
+            self.ensure_space(len(blob))
+            self.store.put_raw(oid, blob)
+        self._notify_sealed([oid_hex])
+        return True
+
+    def client_get(self, oid_hex: str, timeout: float = 30.0) -> Optional[bytes]:
+        """Returns the framed payload for a remote client driver, pulling
+        or restoring the object first when needed. None on timeout. Rides
+        wait_objects (seal-notification waits + bounded location checks +
+        async pulls) rather than a pull_object retry loop — a client
+        blocked on a still-running task must not hammer the GCS."""
+        oid = ObjectID.from_hex(oid_hex)
+        deadline = time.monotonic() + timeout
+        while True:
+            if self.store.contains(oid) or oid_hex in self._spilled:
+                if not self.store.contains(oid):
+                    self._restore(oid_hex)
+                raw = self.store.get_raw(oid)
+                if raw is not None:
+                    return raw
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            self.wait_objects([oid_hex], 1, min(remaining, 5.0), pull=True)
+
     def pull_object(self, oid_hex: str, timeout: float = 30.0) -> bool:
         """Ensures the object is in the local store, fetching from a remote
         node if needed (reference: pull_manager.h:52)."""

@@ -61,13 +61,31 @@ def cmd_start(args) -> None:
             f"(session dir: {info['session_dir']})"
         )
         return
+    node_ip = args.node_ip_address
+    if node_ip is None:
+        # With a TCP port the whole point is reachability from OTHER
+        # hosts: default to this machine's primary routable ip (the UDP
+        # "connect" trick needs no egress), not loopback — a printed
+        # tcp://127.0.0.1 join address would point every joiner at itself.
+        node_ip = "127.0.0.1"
+        if args.port is not None:
+            import socket as _socket
+
+            probe = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+            try:
+                probe.connect(("10.255.255.255", 1))
+                node_ip = probe.getsockname()[0]
+            except OSError:
+                pass
+            finally:
+                probe.close()
     cluster = Cluster(
         num_cpus=args.num_cpus,
         num_tpus=args.num_tpus,
         resources=resources,
         object_store_memory=args.object_store_memory,
         head_port=args.port,
-        node_ip=args.node_ip_address or "127.0.0.1",
+        node_ip=node_ip,
     )
     # The daemons must outlive this CLI process (reference: `ray start`
     # leaves raylets running): drop the kill-children atexit hook.

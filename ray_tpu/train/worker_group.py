@@ -77,19 +77,33 @@ class _TrainWorker:
         config: Dict[str, Any],
         trial_name: str,
         checkpoint_path: Optional[str],
+        setup_mesh_axes: Optional[Dict[str, int]] = "__unset__",  # type: ignore[assignment]
     ):
         import cloudpickle
 
         from .checkpoint import Checkpoint
 
-        fn = cloudpickle.loads(fn_blob)
-        ckpt = Checkpoint(checkpoint_path) if checkpoint_path else None
-        session = init_session(
-            world_rank=self.rank,
-            world_size=self.world_size,
-            trial_name=trial_name,
-            checkpoint=ckpt,
-        )
+        try:
+            if setup_mesh_axes != "__unset__":
+                # Folded-in mesh setup: a concurrent actor
+                # (max_concurrency>1) gives no cross-method ordering, so
+                # callers that must not block on a separate setup_mesh ack
+                # pass the axes here.
+                self.setup_mesh(setup_mesh_axes)
+            fn = cloudpickle.loads(fn_blob)
+            ckpt = Checkpoint(checkpoint_path) if checkpoint_path else None
+            session = init_session(
+                world_rank=self.rank,
+                world_size=self.world_size,
+                trial_name=trial_name,
+                checkpoint=ckpt,
+            )
+        except BaseException as e:  # noqa: BLE001
+            # Fire-and-forget launches discard this call's ref: record the
+            # failure where next_result() re-raises it, or a bad trial
+            # would stall 60 s and end as a silent empty success.
+            self._error = e
+            raise
         session.mesh = self._mesh
         self._session = session
 
@@ -115,9 +129,20 @@ class _TrainWorker:
         return True
 
     def next_result(self):
+        import time as _time
+
+        # The launch is fire-and-forget and this actor runs methods on a
+        # thread pool: next_result can land before start_training has
+        # initialized the session — wait for it (bounded) instead of
+        # reporting a phantom end-of-training.
+        deadline = _time.monotonic() + 60.0
+        while self._session is None:
+            if self._error is not None:
+                raise self._error
+            if _time.monotonic() > deadline:
+                return None
+            _time.sleep(0.02)
         session = self._session
-        if session is None:
-            return None
         out = session.next_result()
         if out is None and self._error is not None:
             raise self._error

@@ -11,6 +11,7 @@ from .schedulers import (
 from .search import (
     BasicVariantGenerator,
     Searcher,
+    TPESearcher,
     choice,
     grid_search,
     lograndint,
@@ -27,7 +28,7 @@ ASHAScheduler = AsyncHyperBandScheduler
 __all__ = [
     "ASHAScheduler", "AsyncHyperBandScheduler", "BasicVariantGenerator",
     "FIFOScheduler", "MedianStoppingRule", "PopulationBasedTraining",
-    "ResultGrid", "Searcher", "Trial", "TrialScheduler", "TuneConfig",
+    "ResultGrid", "Searcher", "TPESearcher", "Trial", "TrialScheduler", "TuneConfig",
     "Tuner", "choice", "get_checkpoint", "grid_search", "lograndint",
     "loguniform", "quniform", "randint", "report", "sample_from", "uniform",
 ]

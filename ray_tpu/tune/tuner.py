@@ -219,14 +219,16 @@ class _TuneController:
         worker_cls = api.remote(max_concurrency=4)(_TrainWorker)
         actor = worker_cls.remote(0, 1)
         blob = cloudpickle.dumps(self._fn)
-        api.get(actor.setup_mesh.remote(None))
-        api.get(
-            actor.start_training.remote(
-                blob,
-                {**self._base_config, **trial.config},
-                trial.trial_id,
-                checkpoint_path or trial.latest_checkpoint,
-            )
+        # Fire-and-forget launch: blocking on a setup ack here deadlocks a
+        # full cluster — this actor may be QUEUED behind running trials
+        # whose results only this loop can consume. Mesh setup rides
+        # inside start_training (concurrent actors don't order methods).
+        actor.start_training.remote(
+            blob,
+            {**self._base_config, **trial.config},
+            trial.trial_id,
+            checkpoint_path or trial.latest_checkpoint,
+            setup_mesh_axes=None,
         )
         trial.status = "RUNNING"
         self._actors[trial.trial_id] = actor

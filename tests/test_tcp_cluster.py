@@ -120,3 +120,60 @@ def test_parse_address_rejects_portless_tcp():
         parse_address("tcp://10.0.0.1")
     assert parse_address("tcp://10.0.0.1:6379") == ("tcp", ("10.0.0.1", 6379))
     assert parse_address("/tmp/x.sock") == ("uds", "/tmp/x.sock")
+
+
+def test_remote_client_driver(tcp_cluster):
+    """ray-client analogue (reference: util/client/): a driver process
+    attaches with ONLY the head's tcp:// address — no session dir, no
+    local store — and gets the full API through the gateway raylet."""
+    import numpy as np
+
+    cluster, joined = tcp_cluster
+    # Drive from a subprocess so nothing is inherited from the in-process
+    # cluster (the client path must stand on the TCP address alone).
+    import subprocess, sys, textwrap
+
+    script = textwrap.dedent(
+        f"""
+        import numpy as np
+        import ray_tpu as rt
+
+        rt.init(address={cluster.gcs_tcp_address!r})
+
+        @rt.remote
+        def square(x):
+            return x * x
+
+        assert rt.get([square.remote(i) for i in range(5)], timeout=60) == [0, 1, 4, 9, 16]
+
+        # objects through the proxy, both directions
+        ref = rt.put(np.arange(1 << 16, dtype=np.float32))
+        @rt.remote
+        def total(a):
+            return float(a.sum())
+        expect = float(np.arange(1 << 16, dtype=np.float32).sum())
+        assert rt.get(total.remote(ref), timeout=60) == expect
+
+        # actors via the client
+        @rt.remote
+        class Counter:
+            def __init__(self): self.n = 0
+            def bump(self): self.n += 1; return self.n
+        c = Counter.remote()
+        assert rt.get([c.bump.remote() for _ in range(3)], timeout=60) == [1, 2, 3]
+        assert rt.cluster_resources().get("CPU", 0) >= 3
+        rt.shutdown()
+        print("CLIENT_OK")
+        """
+    )
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=repo_root,
+    )
+    assert "CLIENT_OK" in out.stdout, (out.stdout, out.stderr[-2000:])

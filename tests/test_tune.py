@@ -203,3 +203,63 @@ def test_tuner_restore_resumes_unfinished(rt, tmp_path):
     by_id = {r.metrics.get("trial_id"): r.metrics for r in results}
     assert by_id["trial_00000"]["v"] == 100  # carried over, not re-run
     assert by_id["trial_00001"]["v"] == 700  # resumed and completed
+
+
+def test_tpe_searcher_concentrates():
+    """Unit: after startup, TPE suggestions concentrate near the optimum of
+    a quadratic (reference analogue: hyperopt/optuna TPE wrappers)."""
+    from ray_tpu.tune.search import TPESearcher, uniform, choice
+
+    s = TPESearcher(
+        {"x": uniform(-1.0, 1.0), "y": uniform(-1.0, 1.0), "kind": choice(["a", "b"])},
+        metric="loss",
+        mode="min",
+        num_samples=60,
+        n_startup_trials=12,
+        seed=3,
+    )
+    def loss(cfg):
+        penalty = 0.0 if cfg["kind"] == "a" else 0.5
+        return (cfg["x"] - 0.6) ** 2 + (cfg["y"] + 0.4) ** 2 + penalty
+
+    early, late = [], []
+    for i in range(60):
+        tid = f"t{i}"
+        cfg = s.suggest(tid)
+        assert cfg is not None
+        l = loss(cfg)
+        (early if i < 12 else late).append(l)
+        s.on_trial_complete(tid, {"loss": l})
+    assert s.suggest("overflow") is None  # num_samples exhausted
+    # The model phase must be much better than the random startup phase
+    # (thresholds from seeded runs; TPE on 48 model trials refines to
+    # ~1e-1 on this 2D quadratic, not to machine precision).
+    assert min(late) <= 0.12, min(late)
+    assert sum(late) / len(late) < 0.3 * (sum(early) / len(early))
+    # Categorical model should have locked onto the better arm.
+    assert sum(1 for l in late if l < 0.5) > len(late) * 0.6
+
+
+def test_tpe_with_tuner_end_to_end(rt, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.train import RunConfig
+
+    def objective(config):
+        tune.report({"score": -((config["lr"] - 0.3) ** 2)})
+
+    space = {"lr": tune.uniform(0.0, 1.0)}
+    results = tune.Tuner(
+        objective,
+        param_space=space,
+        tune_config=tune.TuneConfig(
+            metric="score",
+            mode="max",
+            search_alg=tune.TPESearcher(
+                space, metric="score", mode="max", num_samples=25,
+                n_startup_trials=8, seed=3,
+            ),
+        ),
+        run_config=RunConfig(name="tpe", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 25 and not results.errors
+    assert results.get_best_result().metrics["score"] > -0.01
