@@ -143,7 +143,7 @@ def _process_args(args, kwargs):
     return tuple(conv(a) for a in args), {k: conv(v) for k, v in (kwargs or {}).items()}
 
 
-def _build_sched_options(opts: Dict[str, Any]) -> SchedulingOptions:
+def _build_sched_options(opts: Dict[str, Any], for_actor: bool = False) -> SchedulingOptions:
     bad = set(opts) - _VALID_OPTIONS
     if bad:
         raise ValueError(f"invalid option(s) {sorted(bad)}; valid: {sorted(_VALID_OPTIONS)}")
@@ -192,6 +192,12 @@ def _build_sched_options(opts: Dict[str, Any]) -> SchedulingOptions:
             num_gpus=opts.get("num_gpus"),
             memory=opts.get("memory"),
             resources=opts.get("resources"),
+            # Actors hold 0 CPUs while alive unless num_cpus is explicit
+            # (reference: actor resource defaults, python/ray/actor.py —
+            # 1 CPU biases placement only, 0 is held at runtime); without
+            # this, every idle actor pins a core and a handful of utility
+            # actors starves task workers.
+            default_num_cpus=0.0 if for_actor else 1.0,
         ),
         placement_group_id=pg_id,
         bundle_index=bundle_index,
@@ -373,9 +379,7 @@ class ActorClass:
         if self._blob is None:
             self._blob, self._hash = FunctionTable.dumps(self._cls)
         pargs, pkwargs = _process_args(args, kwargs)
-        opts = _build_sched_options(self._options)
-        # Actors default to 0 CPUs held while idle, 1 CPU for creation, as in
-        # the reference (python/ray/actor.py resource defaults).
+        opts = _build_sched_options(self._options, for_actor=True)
         spec = TaskSpec(
             task_id=TaskID.for_task(),
             task_type=TaskType.ACTOR_CREATION,

@@ -668,7 +668,12 @@ class ClusterRuntime(Runtime):
             "register_actor",
             actor_id.hex(),
             blob,
-            entry["resources"],
+            # Placement bias (reference: actors use 1 CPU for SCHEDULING,
+            # 0 while alive): a default actor holds nothing at runtime
+            # (entry["resources"] is empty) but is PLACED as if it cost a
+            # CPU, so utility-actor swarms spread instead of piling onto
+            # the most-utilized node.
+            entry["resources"] or {"CPU": 1.0},
             spec.options.max_restarts,
             spec.options.name,
             spec.options.namespace,

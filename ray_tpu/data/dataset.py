@@ -22,7 +22,7 @@ from __future__ import annotations
 import itertools
 import random
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Union
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -69,6 +69,9 @@ class _Op:
     descending: bool = False
     seed: Optional[int] = None
     concurrency: Optional[int] = None  # actor-pool size for map_batches
+    aggs: Optional[Dict[str, Tuple[str, Optional[str]]]] = None  # groupby
+    group_fn: Optional[Callable] = None  # groupby map_groups
+    datasets: Optional[List["Dataset"]] = None  # union members
 
     def fusable(self) -> bool:
         return self.kind in ("map_rows", "filter", "flat_map", "map_batches") and (
@@ -223,6 +226,18 @@ class Dataset:
     def sort(self, key: str, descending: bool = False) -> "Dataset":
         return self._extended(_Op(kind="sort", key=key, descending=descending))
 
+    def groupby(self, key: str) -> "GroupedData":
+        """Groups rows by a column (reference: Dataset.groupby ->
+        GroupedData, python/ray/data/grouped_data.py). Aggregations run as
+        a distributed hash shuffle: each block splits into hash partitions,
+        each partition reduces independently."""
+        return GroupedData(self, key)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenates datasets block-wise, lazily: members execute only
+        when the union is iterated (reference: Dataset.union)."""
+        return Dataset.from_ops([_Op(kind="union", datasets=[self, *others])])
+
     def limit(self, n: int) -> "Dataset":
         return self._extended(_Op(kind="limit", n=n))
 
@@ -248,7 +263,7 @@ class Dataset:
         a fused chain, an actor-pool map, or a barrier op."""
         ops = self._optimize(self._ops)
         source = ops[0]
-        assert source.kind in ("read", "input")
+        assert source.kind in ("read", "input", "union")
         stages: List[Any] = []
         fused: List[_Op] = []
         for op in ops[1:]:
@@ -269,6 +284,10 @@ class Dataset:
         _ensure_initialized()
         if source.kind == "input":
             yield from list(source.blocks or [])
+            return
+        if source.kind == "union":
+            for member in source.datasets or []:
+                yield from member.iter_block_refs()
             return
         tasks = source.datasource.get_read_tasks(source.parallelism)
 
@@ -305,6 +324,8 @@ class Dataset:
                 refs = iter(self._shuffle(list(refs), payload.seed))
             elif kind == "sort":
                 refs = iter(self._sort(list(refs), payload))
+            elif kind == "groupby":
+                refs = iter(self._groupby(list(refs), payload))
             elif kind == "limit":
                 refs = self._limit_iter(refs, payload.n)
             else:  # pragma: no cover
@@ -406,6 +427,67 @@ class Dataset:
             rows.extend(BlockAccessor(b).iter_rows())
         rows.sort(key=lambda r: r[op.key], reverse=op.descending)
         return [api.put(block_from_rows(rows))]
+
+    def _groupby(self, refs: List[Any], op: _Op) -> List[Any]:
+        """Distributed hash-shuffle groupby (reference: the shuffle-based
+        groupby planner, _internal/planner/exchange/). Map side: every
+        block splits into P hash partitions (multi-return task). Reduce
+        side: partition j gathers the j-th split of every block and
+        groups/aggregates locally."""
+        if not refs:
+            return []
+        P = max(1, min(len(refs), 8))
+        key, aggs, group_fn = op.key, op.aggs, op.group_fn
+
+        @api.remote
+        def split(block: Block, P=P, key=key):
+            parts: List[List[Any]] = [[] for _ in _range(P)]
+            for row in BlockAccessor(block).iter_rows():
+                parts[_stable_hash(row[key]) % P].append(row)
+            out = tuple(block_from_rows(p) for p in parts)
+            return out if P > 1 else out[0]
+
+        part_refs = [split.options(num_returns=P).remote(r) for r in refs]
+        if P == 1:
+            part_refs = [[r] for r in part_refs]
+
+        @api.remote
+        def reduce(key, aggs, group_fn, *parts):
+            groups: Dict[Any, List[Any]] = {}
+            for b in parts:
+                for row in BlockAccessor(b).iter_rows():
+                    groups.setdefault(row[key], []).append(row)
+            out_rows: List[Any] = []
+            for k in sorted(groups, key=repr):
+                rows = groups[k]
+                if group_fn is not None:
+                    res = group_fn(rows)
+                    out_rows.extend(res if isinstance(res, list) else [res])
+                    continue
+                o: Dict[str, Any] = {key: k}
+                for name, (akind, col) in aggs.items():
+                    vals = [r[col] for r in rows] if col else rows
+                    if akind == "count":
+                        o[name] = len(rows)
+                    elif akind == "sum":
+                        o[name] = sum(vals)
+                    elif akind == "mean":
+                        o[name] = sum(vals) / len(vals)
+                    elif akind == "min":
+                        o[name] = min(vals)
+                    elif akind == "max":
+                        o[name] = max(vals)
+                    else:  # pragma: no cover
+                        raise ValueError(f"unknown aggregation {akind!r}")
+                out_rows.append(o)
+            return block_from_rows(out_rows)
+
+        return [
+            reduce.remote(
+                key, aggs, group_fn, *[part_refs[i][j] for i in _range(len(part_refs))]
+            )
+            for j in _range(P)
+        ]
 
     def _limit_iter(self, refs: Iterator[Any], n: int) -> Iterator[Any]:
         """Streaming limit: stops pulling upstream once n rows are covered,
@@ -550,3 +632,58 @@ def read_csv(paths, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
 
 def read_json(paths, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
     return Dataset([_Op(kind="read", datasource=JSONDatasource(paths), parallelism=parallelism)])
+
+
+def _stable_hash(v: Any) -> int:
+    """Deterministic cross-process hash: builtin hash() is salted per
+    process, so map-side partitioning in different workers would scatter
+    the same key across partitions. Numerics canonicalize to their float
+    form when exactly representable (0 == 0.0 == False must land in ONE
+    partition — the reduce side groups by Python equality)."""
+    import hashlib
+
+    if isinstance(v, (bool, int, float)) and not isinstance(v, float):
+        try:
+            if float(v) == v:
+                v = float(v)
+        except OverflowError:
+            pass
+    return int.from_bytes(
+        hashlib.md5(repr(v).encode("utf-8", "backslashreplace")).digest()[:8], "little"
+    )
+
+
+class GroupedData:
+    """Result of Dataset.groupby (reference: python/ray/data/grouped_data.py
+    GroupedData.count/sum/mean/min/max/map_groups)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, aggs: Dict[str, Tuple[str, Optional[str]]]) -> Dataset:
+        return self._ds._extended(_Op(kind="groupby", key=self._key, aggs=aggs))
+
+    def count(self) -> Dataset:
+        return self._agg({"count()": ("count", None)})
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg({f"sum({col})": ("sum", col)})
+
+    def mean(self, col: str) -> Dataset:
+        return self._agg({f"mean({col})": ("mean", col)})
+
+    def min(self, col: str) -> Dataset:
+        return self._agg({f"min({col})": ("min", col)})
+
+    def max(self, col: str) -> Dataset:
+        return self._agg({f"max({col})": ("max", col)})
+
+    def aggregate(self, **aggs: Tuple[str, Optional[str]]) -> Dataset:
+        """aggregate(total=("sum", "v"), n=("count", None), ...)"""
+        return self._agg(dict(aggs))
+
+    def map_groups(self, fn: Callable[[List[Any]], Any]) -> Dataset:
+        """Applies fn to each group's row list; fn returns a row or a list
+        of rows (reference: GroupedData.map_groups)."""
+        return self._ds._extended(_Op(kind="groupby", key=self._key, group_fn=fn))

@@ -134,8 +134,10 @@ class _TrainWorker:
         # The launch is fire-and-forget and this actor runs methods on a
         # thread pool: next_result can land before start_training has
         # initialized the session — wait for it (bounded) instead of
-        # reporting a phantom end-of-training.
-        deadline = _time.monotonic() + 60.0
+        # reporting a phantom end-of-training. The bound must comfortably
+        # exceed worst-case setup (multi-host mesh init + unpickling a
+        # large closure), or a slow start reads as an empty success.
+        deadline = _time.monotonic() + 600.0
         while self._session is None:
             if self._error is not None:
                 raise self._error

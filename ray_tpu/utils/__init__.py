@@ -4,4 +4,6 @@
 from . import state
 from .config import CONFIG, RayTpuConfig, all_flags
 
-__all__ = ["CONFIG", "RayTpuConfig", "all_flags", "state"]
+__all__ = ["CONFIG", "RayTpuConfig", "all_flags", "state", "ActorPool", "Queue", "Empty", "Full"]
+from .actor_pool import ActorPool  # noqa: F401
+from .queue import Empty, Full, Queue  # noqa: F401

@@ -1,0 +1,129 @@
+"""Distributed FIFO queue backed by an actor.
+
+Re-design of the reference's ray.util.queue.Queue (reference:
+python/ray/util/queue.py — an async-actor-hosted queue shared between
+tasks/actors/drivers, with optional maxsize and blocking put/get).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from .. import api
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    """Async actor body: awaits on an asyncio.Queue so concurrent blocking
+    gets/puts don't occupy worker threads (reference: util/queue.py uses
+    the same asyncio-actor shape)."""
+
+    def __init__(self, maxsize: int = 0):
+        import asyncio
+
+        self._q: "asyncio.Queue" = asyncio.Queue(maxsize=maxsize)
+
+    async def put(self, item: Any, timeout: Optional[float] = None) -> bool:
+        import asyncio
+
+        if timeout is None:
+            await self._q.put(item)
+            return True
+        try:
+            await asyncio.wait_for(self._q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def get(self, timeout: Optional[float] = None):
+        import asyncio
+
+        if timeout is None:
+            return (True, await self._q.get())
+        try:
+            return (True, await asyncio.wait_for(self._q.get(), timeout))
+        except asyncio.TimeoutError:
+            return (False, None)
+
+    async def put_nowait(self, item: Any) -> bool:
+        try:
+            self._q.put_nowait(item)
+            return True
+        except Exception:
+            return False
+
+    async def get_nowait(self):
+        try:
+            return (True, self._q.get_nowait())
+        except Exception:
+            return (False, None)
+
+    async def qsize(self) -> int:
+        return self._q.qsize()
+
+    async def empty(self) -> bool:
+        return self._q.empty()
+
+    async def full(self) -> bool:
+        return self._q.full()
+
+
+class Queue:
+    """Driver/task-side handle; all operations proxy to the queue actor."""
+
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("max_concurrency", 64)
+        self._actor = api.remote(**opts)(_QueueActor).remote(maxsize)
+        self._maxsize = maxsize
+
+    def put(self, item: Any, block: bool = True, timeout: Optional[float] = None) -> None:
+        if not block:
+            if not api.get(self._actor.put_nowait.remote(item)):
+                raise Full("queue is full")
+            return
+        if not api.get(self._actor.put.remote(item, timeout)):
+            raise Full("queue is full (timeout)")
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        if not block:
+            ok, item = api.get(self._actor.get_nowait.remote())
+            if not ok:
+                raise Empty("queue is empty")
+            return item
+        ok, item = api.get(self._actor.get.remote(timeout))
+        if not ok:
+            raise Empty("queue is empty (timeout)")
+        return item
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        for it in items:
+            self.put_nowait(it)
+
+    def get_nowait_batch(self, n: int) -> List[Any]:
+        return [self.get_nowait() for _ in range(n)]
+
+    def qsize(self) -> int:
+        return api.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return api.get(self._actor.empty.remote())
+
+    def full(self) -> bool:
+        return api.get(self._actor.full.remote())
+
+    def shutdown(self) -> None:
+        api.kill(self._actor)

@@ -216,3 +216,83 @@ def test_memory_budget_bounds_window(rt):
     # A tiny byte budget must still stream every block correctly.
     refs = list(ds.iter_block_refs(prefetch=8, memory_budget=1))
     assert len(refs) == 20
+
+
+def test_groupby_aggregations(rt):
+    from ray_tpu import data
+
+    ds = data.from_items(
+        [{"k": i % 3, "v": float(i)} for i in range(30)]
+    )
+    out = {r["k"]: r for r in ds.groupby("k").count().take_all()}
+    assert {k: r["count()"] for k, r in out.items()} == {0: 10, 1: 10, 2: 10}
+
+    sums = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert sums == {
+        0: sum(float(i) for i in range(30) if i % 3 == 0),
+        1: sum(float(i) for i in range(30) if i % 3 == 1),
+        2: sum(float(i) for i in range(30) if i % 3 == 2),
+    }
+
+    means = {r["k"]: r["mean(v)"] for r in ds.groupby("k").mean("v").take_all()}
+    assert means[0] == sums[0] / 10
+
+    multi = {
+        r["k"]: r
+        for r in ds.groupby("k")
+        .aggregate(lo=("min", "v"), hi=("max", "v"), n=("count", None))
+        .take_all()
+    }
+    assert multi[1]["lo"] == 1.0 and multi[1]["hi"] == 28.0 and multi[1]["n"] == 10
+
+
+def test_groupby_map_groups_and_chain(rt):
+    from ray_tpu import data
+
+    ds = data.from_items([{"k": "a" if i < 4 else "b", "v": i} for i in range(10)])
+    rows = (
+        ds.groupby("k")
+        .map_groups(lambda rows: {"k": rows[0]["k"], "n": len(rows)})
+        .filter(lambda r: r["n"] > 4)
+        .take_all()
+    )
+    assert rows == [{"k": "b", "n": 6}]
+
+
+def test_union(rt):
+    from ray_tpu import data
+
+    a = data.from_items([0, 1, 2, 3, 4])
+    b = data.from_items([0, 1, 2]).map(lambda x: x + 100)
+    got = sorted(a.union(b).take_all())
+    assert got == [0, 1, 2, 3, 4, 100, 101, 102]
+
+
+def test_groupby_numeric_key_equivalence(rt):
+    """0, 0.0 and False are one group (partitioning must agree with the
+    reduce side's Python-equality grouping)."""
+    from ray_tpu import data
+
+    ds = data.from_items(
+        [{"k": 0, "v": 1.0}, {"k": 0.0, "v": 3.0}, {"k": 1, "v": 5.0}, {"k": True, "v": 7.0}],
+        parallelism=4,
+    )
+    out = {repr(r["k"]): r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert len(out) == 2, out
+    assert sum(out.values()) == 16.0
+
+
+def test_union_is_lazy(rt):
+    from ray_tpu import data
+
+    ran = []
+
+    def spy(x):
+        ran.append(x)
+        return x
+
+    a = data.from_items([1, 2]).map(spy)
+    b = data.from_items([3]).map(spy)
+    u = a.union(b)  # building the plan must execute nothing
+    assert ran == []
+    assert sorted(u.take_all()) == [1, 2, 3]

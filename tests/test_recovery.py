@@ -233,3 +233,52 @@ def test_escaped_put_ref_not_eagerly_freed(rt_cluster):
     out_ref = consume.remote(ref)
     del ref  # the task (maybe not yet started) still needs the object
     assert rt.get(out_ref, timeout=60) == float(1 << 20)
+
+
+def test_actor_pool(rt_cluster):
+    from ray_tpu.utils import ActorPool
+
+    rt = rt_cluster
+
+    @rt.remote
+    class Doubler:
+        def work(self, x):
+            return 2 * x
+
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    assert list(pool.map(lambda a, v: a.work.remote(v), range(6))) == [0, 2, 4, 6, 8, 10]
+    assert sorted(pool.map_unordered(lambda a, v: a.work.remote(v), range(4))) == [0, 2, 4, 6]
+    # submit/get_next interleave
+    pool.submit(lambda a, v: a.work.remote(v), 21)
+    assert pool.get_next(timeout=60) == 42
+    assert not pool.has_next()
+
+
+def test_distributed_queue(rt_cluster):
+    from ray_tpu.utils import Empty, Queue
+
+    rt = rt_cluster
+    q = Queue(maxsize=4)
+
+    @rt.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    @rt.remote
+    def consumer(q, n):
+        return [q.get(timeout=30) for _ in range(n)]
+
+    p = producer.remote(q, 8)
+    got = rt.get(consumer.remote(q, 8), timeout=60)
+    assert got == list(range(8))
+    assert rt.get(p, timeout=30) is True
+    assert q.empty()
+    import pytest as _pytest
+
+    with _pytest.raises(Empty):
+        q.get_nowait()
+    q.put_nowait(99)
+    assert q.qsize() == 1 and q.get_nowait() == 99
+    q.shutdown()
