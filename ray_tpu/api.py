@@ -212,6 +212,7 @@ def _build_sched_options(opts: Dict[str, Any], for_actor: bool = False) -> Sched
         namespace=opts.get("namespace"),
         lifetime=opts.get("lifetime"),
         runtime_env=opts.get("runtime_env"),
+        actor_placement_bias=for_actor and opts.get("num_cpus") is None,
     )
 
 

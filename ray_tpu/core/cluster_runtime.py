@@ -669,11 +669,13 @@ class ClusterRuntime(Runtime):
             actor_id.hex(),
             blob,
             # Placement bias (reference: actors use 1 CPU for SCHEDULING,
-            # 0 while alive): a default actor holds nothing at runtime
+            # 0 while alive): a DEFAULT actor holds nothing at runtime
             # (entry["resources"] is empty) but is PLACED as if it cost a
             # CPU, so utility-actor swarms spread instead of piling onto
-            # the most-utilized node.
-            entry["resources"] or {"CPU": 1.0},
+            # the most-utilized node. An EXPLICIT num_cpus=0 actor skips
+            # the bias — it must place on CPU-less custom-resource hosts.
+            entry["resources"]
+            or ({"CPU": 1.0} if spec.options.actor_placement_bias else {}),
             spec.options.max_restarts,
             spec.options.name,
             spec.options.namespace,

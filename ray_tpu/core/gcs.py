@@ -62,6 +62,7 @@ class GcsService:
         self._free_queue: List[Tuple[float, List[str]]] = []
         self._freed: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
         self._raylet_clients: Dict[str, Any] = {}
+        self._user_metrics: Dict[Tuple, dict] = {}
         self._stop = threading.Event()
         if snapshot_path:
             self._load_snapshot()
@@ -234,6 +235,57 @@ class GcsService:
                         "pending_free": h in self._deferred_free,
                     }
                 )
+        return out
+
+    def report_metrics(self, worker_id: str, records: List[dict]) -> bool:
+        """Aggregates user-defined application metrics (reference:
+        src/ray/stats/metric.h registry + exporter; python surface
+        ray.util.metrics). Counters accumulate deltas; gauges keep the
+        last value per (worker, tags); histograms merge bucket counts."""
+        with self._lock:
+            for rec in records:
+                key = (rec["name"], tuple(sorted(rec.get("tags", {}).items())))
+                entry = self._user_metrics.setdefault(
+                    key,
+                    {
+                        "name": rec["name"],
+                        "kind": rec["kind"],
+                        "tags": dict(rec.get("tags", {})),
+                        "value": 0.0,
+                        "gauges": {},
+                    },
+                )
+                if rec["kind"] == "counter":
+                    entry["value"] += float(rec["value"])
+                elif rec["kind"] == "gauge":
+                    entry["gauges"][worker_id] = (float(rec["value"]), time.monotonic())
+                elif rec["kind"] == "histogram":
+                    entry["value"] += float(rec["value"])
+                    counts = rec.get("counts") or []
+                    have = entry.setdefault("counts", [0] * len(counts))
+                    if len(have) == len(counts):
+                        entry["counts"] = [a + b for a, b in zip(have, counts)]
+                    entry.setdefault("boundaries", rec.get("boundaries"))
+        return True
+
+    def user_metrics(self) -> List[dict]:
+        now = time.monotonic()
+        out: List[dict] = []
+        with self._lock:
+            for v in self._user_metrics.values():
+                entry = dict(v)
+                if entry["kind"] == "gauge":
+                    # A dead worker's last gauge value must not inflate the
+                    # cluster sum forever: only reporters fresh within 30 s
+                    # count (gauges re-report every flush interval).
+                    live = {
+                        w: val
+                        for w, (val, ts) in entry["gauges"].items()
+                        if now - ts < 30.0
+                    }
+                    entry["value"] = sum(live.values())
+                    entry["gauges"] = live
+                out.append(entry)
         return out
 
     def stats(self) -> dict:

@@ -42,6 +42,9 @@ class SchedulingOptions:
     namespace: Optional[str] = None
     lifetime: Optional[str] = None         # None | "detached"
     runtime_env: Optional[dict] = None
+    # Actors: True when num_cpus was defaulted (hold 0, but PLACE as if 1
+    # CPU); False when the user set it explicitly — even to 0.
+    actor_placement_bias: bool = False
 
 
 @dataclass

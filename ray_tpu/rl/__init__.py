@@ -24,7 +24,15 @@ from .connectors import (
     FlattenObs,
     NormalizeObs,
 )
-from .offline import BC, BCConfig, bc_loss, rollouts_to_dataset
+from .offline import (
+    BC,
+    MARWIL,
+    BCConfig,
+    MARWILConfig,
+    bc_loss,
+    marwil_loss,
+    rollouts_to_dataset,
+)
 from .multi_agent import (
     MultiAgentEnv,
     MultiAgentEnvRunner,
@@ -44,6 +52,7 @@ __all__ = [
     "ppo_loss", "DQN", "DQNConfig", "QModule", "dqn_loss",
     "TransitionReplayBuffer", "MultiAgentEnv", "MultiAgentEnvRunner",
     "MultiAgentPPO", "MultiAgentPPOConfig", "BC", "BCConfig", "bc_loss",
+    "MARWIL", "MARWILConfig", "marwil_loss",
     "rollouts_to_dataset", "Connector", "ConnectorPipeline", "FlattenObs",
     "ClipObs", "NormalizeObs", "SAC", "SACConfig", "SquashedGaussianModule",
 ]

@@ -19,24 +19,40 @@ from .learner import JaxLearner
 from .module import RLModule
 
 
-def rollouts_to_dataset(rollouts: Iterable[Dict[str, np.ndarray]]):
+def rollouts_to_dataset(rollouts: Iterable[Dict[str, np.ndarray]], gamma: float = 0.99):
     """Flattens env-runner rollouts ([T, N, ...] arrays) into a Dataset of
     per-transition columns (reference: offline_data writing SampleBatches).
-    Vectorized: mask-filtered column arrays, no per-row Python objects."""
-    cols: Dict[str, List[np.ndarray]] = {"obs": [], "action": [], "reward": [], "done": []}
+    Vectorized: mask-filtered column arrays, no per-row Python objects.
+    Also emits a discounted return-to-go column (reverse scan with done
+    resets) — the regression target MARWIL's value baseline needs."""
+    cols: Dict[str, List[np.ndarray]] = {
+        "obs": [], "action": [], "reward": [], "done": [], "return": []
+    }
     for ro in rollouts:
         obs, act = np.asarray(ro["obs"]), np.asarray(ro["actions"])
         T, N = act.shape[:2]
+        rewards = np.asarray(ro["rewards"], np.float32).reshape(T, N)
+        dones = np.asarray(ro["dones"], np.float32).reshape(T, N)
+        rtg = np.zeros((T, N), np.float32)
+        acc = np.zeros(N, np.float32)
+        for t in _reversed_range(T):
+            acc = rewards[t] + gamma * acc * (1.0 - dones[t])
+            rtg[t] = acc
         keep = np.ones(T * N, bool)
         mask = ro.get("mask")
         if mask is not None:
             keep = np.asarray(mask).reshape(-1) != 0.0
         cols["obs"].append(obs.reshape((T * N,) + obs.shape[2:])[keep])
         cols["action"].append(act.reshape((T * N,) + act.shape[2:])[keep])
-        cols["reward"].append(np.asarray(ro["rewards"], np.float32).reshape(-1)[keep])
-        cols["done"].append(np.asarray(ro["dones"], np.float32).reshape(-1)[keep])
+        cols["reward"].append(rewards.reshape(-1)[keep])
+        cols["done"].append(dones.reshape(-1)[keep])
+        cols["return"].append(rtg.reshape(-1)[keep])
     merged = {k: np.concatenate(v) if v else np.zeros((0,)) for k, v in cols.items()}
     return ds.from_numpy(merged)
+
+
+def _reversed_range(n: int):
+    return range(n - 1, -1, -1)
 
 
 def bc_loss(module: RLModule, params, batch):
@@ -110,3 +126,78 @@ class BC:
             correct += int((pred == actions).sum())
             total += len(actions)
         return correct / max(1, total)
+
+
+def marwil_loss(module: RLModule, params, batch, *, beta: float = 1.0, vf_coeff: float = 1.0):
+    """Advantage-weighted behavior cloning + value regression (reference:
+    rllib/algorithms/marwil/ — MARWIL's exponentially-weighted imitation
+    loss; beta=0 degenerates to plain BC). Advantages come from the
+    monte-carlo return-to-go minus the learned value baseline."""
+    import jax
+    import jax.numpy as jnp
+
+    out = module.forward_train(params, batch["obs"])
+    logp, _ = module.logp_entropy(out, batch["actions"])
+    returns = batch["returns"]
+    adv = returns - out["vf"]
+    # Weights use a stopped-gradient advantage (the policy must not inflate
+    # its own weights by wrecking the baseline), clipped for stability.
+    w = jnp.minimum(jnp.exp(beta * jax.lax.stop_gradient(adv)), 20.0)
+    policy_loss = -jnp.mean(w * logp)
+    vf_loss = jnp.mean(adv**2)
+    loss = policy_loss + vf_coeff * vf_loss
+    return loss, {
+        "marwil_policy_loss": policy_loss,
+        "marwil_vf_loss": vf_loss,
+        "marwil_mean_weight": jnp.mean(w),
+    }
+
+
+@dataclasses.dataclass
+class MARWILConfig:
+    """(reference: marwil.py MARWILConfig — beta, vf_coeff knobs)"""
+
+    module: RLModule = None
+    beta: float = 1.0
+    vf_coeff: float = 1.0
+    lr: float = 1e-3
+    batch_size: int = 128
+    seed: int = 0
+
+    def build(self) -> "MARWIL":
+        return MARWIL(self)
+
+
+class MARWIL:
+    """Monotonic Advantage Re-Weighted Imitation Learning over an offline
+    Dataset that carries return-to-go (rollouts_to_dataset provides it)."""
+
+    def __init__(self, config: MARWILConfig):
+        import functools
+
+        self.config = config
+        loss = functools.partial(
+            marwil_loss, beta=config.beta, vf_coeff=config.vf_coeff
+        )
+        self.learner = JaxLearner(config.module, loss, lr=config.lr, seed=config.seed)
+        self.iteration = 0
+
+    def train_on_dataset(self, dataset, *, epochs: int = 1) -> Dict[str, float]:
+        metrics: Dict[str, float] = {}
+        for _ in range(epochs):
+            for batch in dataset.iter_batches(
+                batch_size=self.config.batch_size, batch_format="numpy"
+            ):
+                train_batch = {
+                    "obs": np.asarray(batch["obs"], np.float32),
+                    "actions": np.asarray(batch["action"]),
+                    "returns": np.asarray(batch["return"], np.float32),
+                }
+                metrics = self.learner.update(train_batch)
+                self.iteration += 1
+        if not metrics:
+            raise ValueError("offline dataset produced no batches (empty after masking?)")
+        return metrics
+
+    def get_weights(self):
+        return self.learner.get_weights()

@@ -4,6 +4,7 @@
 from . import state
 from .config import CONFIG, RayTpuConfig, all_flags
 
-__all__ = ["CONFIG", "RayTpuConfig", "all_flags", "state", "ActorPool", "Queue", "Empty", "Full"]
+__all__ = ["CONFIG", "RayTpuConfig", "all_flags", "state", "ActorPool", "Queue", "Empty", "Full", "metrics"]
+from . import metrics  # noqa: F401
 from .actor_pool import ActorPool  # noqa: F401
 from .queue import Empty, Full, Queue  # noqa: F401

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Iterable, Iterator, List
 
-from .. import api
+from .. import api, exceptions
 
 
 class ActorPool:
@@ -51,9 +51,20 @@ class ActorPool:
             self._next_return_index = min(self._index_to_future)
         idx = self._next_return_index
         ref = self._index_to_future[idx]
-        # Fetch BEFORE consuming bookkeeping: a GetTimeoutError must leave
-        # the result claimable by a retrying get_next.
-        value = api.get(ref, timeout=timeout)
+        try:
+            # Fetch BEFORE consuming bookkeeping: a timeout must leave the
+            # result claimable by a retrying get_next.
+            value = api.get(ref, timeout=timeout)
+        except exceptions.GetTimeoutError:
+            raise
+        except BaseException:
+            # The TASK failed: its result is consumed (re-raising here is
+            # the delivery) and its actor is free again — without this, one
+            # raising task permanently leaks its actor from the pool.
+            del self._index_to_future[idx]
+            self._next_return_index = idx + 1
+            self._release(ref)
+            raise
         del self._index_to_future[idx]
         self._next_return_index = idx + 1
         self._release(ref)
@@ -72,8 +83,12 @@ class ActorPool:
             if r == ref:
                 del self._index_to_future[idx]
                 break
-        value = api.get(ref)
-        self._release(ref)
+        try:
+            value = api.get(ref)
+        finally:
+            # Ready means the task reached a terminal state: the actor is
+            # free whether the result is a value or a raised error.
+            self._release(ref)
         return value
 
     # ---------------------------------------------------------------- map

@@ -1,0 +1,210 @@
+"""User-defined application metrics: Counter, Gauge, Histogram.
+
+Re-design of the reference's ray.util.metrics (reference:
+python/ray/util/metrics.py Counter/Gauge/Histogram over the C++
+OpenCensus registry, src/ray/stats/metric.h:103, exported to the agent).
+Here each process keeps a local registry and a background flusher pushes
+deltas/values to the GCS metrics table (`report_metrics`), where they
+aggregate per metric+tag-set and surface through
+`ray_tpu.utils.state.user_metrics()` and the dashboard.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_FLUSH_INTERVAL_S = 1.0
+_registry_lock = threading.Lock()
+_registry: List["Metric"] = []
+_instances: Dict[Tuple[str, str], "Metric"] = {}
+_flusher_started = False
+# Records that failed to reach the GCS, retried next flush (bounded so a
+# long GCS outage cannot grow memory without limit).
+_pending_records: List[dict] = []
+_PENDING_CAP = 10_000
+
+
+def _tags_key(tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    """Common base: name, description, default tags; values tracked per
+    tag-set. Constructing the same (class, name) twice returns the SAME
+    instance — the intended pattern of declaring metrics inside task
+    bodies must not grow the process registry per call."""
+
+    kind = "metric"
+
+    def __new__(cls, name: str, *args, **kwargs):
+        key = (cls.__name__, name)
+        with _registry_lock:
+            inst = _instances.get(key)
+            if inst is None:
+                inst = super().__new__(cls)
+                _instances[key] = inst
+            return inst
+
+    def __init__(self, name: str, description: str = "", tag_keys: Tuple[str, ...] = ()):
+        if getattr(self, "_initialized", False):
+            return
+        if not name or not name.replace("_", "").replace(".", "").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self._initialized = True
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        _register(self)
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        out = dict(self._default_tags)
+        out.update(tags or {})
+        extra = set(out) - set(self.tag_keys)
+        if extra:
+            raise ValueError(f"undeclared tag key(s) {sorted(extra)} for {self.name}")
+        return out
+
+    def _collect(self) -> List[dict]:  # pragma: no cover - overridden
+        return []
+
+
+class Counter(Metric):
+    """Monotonic counter (reference: util/metrics.py Counter.inc)."""
+
+    kind = "counter"
+
+    def __init__(self, name, description: str = "", tag_keys: Tuple[str, ...] = ()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("Counter.inc value must be >= 0")
+        k = _tags_key(self._merged(tags))
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def _collect(self) -> List[dict]:
+        with self._lock:
+            vals, self._values = self._values, {}
+        # Counters report DELTAS; the GCS accumulates.
+        return [
+            {"name": self.name, "kind": "counter", "tags": dict(k), "value": v}
+            for k, v in vals.items()
+        ]
+
+
+class Gauge(Metric):
+    """Last-value-wins gauge (reference: util/metrics.py Gauge.set)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, description: str = "", tag_keys: Tuple[str, ...] = ()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        k = _tags_key(self._merged(tags))
+        with self._lock:
+            self._values[k] = float(value)
+
+    def _collect(self) -> List[dict]:
+        with self._lock:
+            vals = dict(self._values)
+        return [
+            {"name": self.name, "kind": "gauge", "tags": dict(k), "value": v}
+            for k, v in vals.items()
+        ]
+
+
+class Histogram(Metric):
+    """Bucketed distribution (reference: util/metrics.py Histogram.observe
+    with explicit boundaries)."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name,
+        description: str = "",
+        boundaries: Optional[List[float]] = None,
+        tag_keys: Tuple[str, ...] = (),
+    ):
+        super().__init__(name, description, tag_keys)
+        if not boundaries:
+            raise ValueError("Histogram requires explicit bucket boundaries")
+        self.boundaries = sorted(float(b) for b in boundaries)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        k = _tags_key(self._merged(tags))
+        import bisect
+
+        idx = bisect.bisect_left(self.boundaries, value)
+        with self._lock:
+            counts = self._counts.setdefault(k, [0] * (len(self.boundaries) + 1))
+            counts[idx] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+
+    def _collect(self) -> List[dict]:
+        with self._lock:
+            counts, self._counts = self._counts, {}
+            sums, self._sums = self._sums, {}
+        return [
+            {
+                "name": self.name,
+                "kind": "histogram",
+                "tags": dict(k),
+                "value": sums.get(k, 0.0),
+                "counts": c,
+                "boundaries": self.boundaries,
+            }
+            for k, c in counts.items()
+        ]
+
+
+def _register(metric: Metric) -> None:
+    global _flusher_started
+    with _registry_lock:
+        _registry.append(metric)
+        if not _flusher_started:
+            _flusher_started = True
+            threading.Thread(target=_flush_loop, daemon=True, name="metrics").start()
+
+
+def _flush_once() -> None:
+    global _pending_records
+    from ..core import runtime_base
+
+    rt = runtime_base.maybe_runtime()
+    gcs = getattr(rt, "_gcs", None)
+    if gcs is None:
+        return
+    with _registry_lock:
+        metrics = list(_registry)
+        records, _pending_records = _pending_records, []
+    for m in metrics:
+        records.extend(m._collect())
+    if records:
+        try:
+            gcs.call("report_metrics", getattr(rt, "_worker_id", "?"), records)
+        except Exception:
+            # _collect() already drained the deltas: keep them for the
+            # next flush or a GCS hiccup silently loses counts.
+            with _registry_lock:
+                _pending_records = (records + _pending_records)[:_PENDING_CAP]
+
+
+def _flush_loop() -> None:
+    while True:
+        time.sleep(_FLUSH_INTERVAL_S)
+        _flush_once()

@@ -1,15 +1,25 @@
 """Distributed FIFO queue backed by an actor.
 
 Re-design of the reference's ray.util.queue.Queue (reference:
-python/ray/util/queue.py — an async-actor-hosted queue shared between
+python/ray/util/queue.py — an actor-hosted queue shared between
 tasks/actors/drivers, with optional maxsize and blocking put/get).
+
+Design note: actor methods never block — blocking semantics live in the
+CLIENT as a poll loop. An actor that awaited inside get()/put() would hold
+one of its max_concurrency slots per blocked caller, and enough blocked
+consumers would starve the producer's call out of the actor entirely
+(deadlock). Non-blocking methods keep every slot short-lived.
 """
 
 from __future__ import annotations
 
+import time
+from collections import deque
 from typing import Any, List, Optional
 
 from .. import api
+
+_POLL_S = 0.02
 
 
 class Empty(Exception):
@@ -21,58 +31,46 @@ class Full(Exception):
 
 
 class _QueueActor:
-    """Async actor body: awaits on an asyncio.Queue so concurrent blocking
-    gets/puts don't occupy worker threads (reference: util/queue.py uses
-    the same asyncio-actor shape)."""
+    """Purely non-blocking queue state holder."""
 
     def __init__(self, maxsize: int = 0):
-        import asyncio
+        self._q: deque = deque()
+        self._maxsize = maxsize
 
-        self._q: "asyncio.Queue" = asyncio.Queue(maxsize=maxsize)
-
-    async def put(self, item: Any, timeout: Optional[float] = None) -> bool:
-        import asyncio
-
-        if timeout is None:
-            await self._q.put(item)
-            return True
-        try:
-            await asyncio.wait_for(self._q.put(item), timeout)
-            return True
-        except asyncio.TimeoutError:
+    def try_put(self, item: Any) -> bool:
+        if self._maxsize and len(self._q) >= self._maxsize:
             return False
+        self._q.append(item)
+        return True
 
-    async def get(self, timeout: Optional[float] = None):
-        import asyncio
-
-        if timeout is None:
-            return (True, await self._q.get())
-        try:
-            return (True, await asyncio.wait_for(self._q.get(), timeout))
-        except asyncio.TimeoutError:
+    def try_get(self):
+        if not self._q:
             return (False, None)
+        return (True, self._q.popleft())
 
-    async def put_nowait(self, item: Any) -> bool:
-        try:
-            self._q.put_nowait(item)
-            return True
-        except Exception:
+    def try_put_batch(self, items: List[Any]) -> bool:
+        """All-or-nothing: a partial enqueue on Full would silently split
+        the batch."""
+        if self._maxsize and len(self._q) + len(items) > self._maxsize:
             return False
+        self._q.extend(items)
+        return True
 
-    async def get_nowait(self):
-        try:
-            return (True, self._q.get_nowait())
-        except Exception:
+    def try_get_batch(self, n: int):
+        """All-or-nothing: draining fewer than n and discarding them would
+        destroy items for every consumer."""
+        if len(self._q) < n:
             return (False, None)
+        return (True, [self._q.popleft() for _ in range(n)])
 
-    async def qsize(self) -> int:
-        return self._q.qsize()
+    def qsize(self) -> int:
+        return len(self._q)
 
-    async def empty(self) -> bool:
-        return self._q.empty()
+    def empty(self) -> bool:
+        return not self._q
 
-    async def full(self) -> bool:
-        return self._q.full()
+    def full(self) -> bool:
+        return bool(self._maxsize) and len(self._q) >= self._maxsize
 
 
 class Queue:
@@ -80,28 +78,42 @@ class Queue:
 
     def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
         opts = dict(actor_options or {})
-        opts.setdefault("max_concurrency", 64)
+        opts.setdefault("max_concurrency", 8)
         self._actor = api.remote(**opts)(_QueueActor).remote(maxsize)
         self._maxsize = maxsize
 
+    def _poll(self, attempt, timeout: Optional[float], fail_exc) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, value = attempt()
+            if ok:
+                return value
+            if deadline is not None and time.monotonic() >= deadline:
+                raise fail_exc
+            time.sleep(_POLL_S)
+
     def put(self, item: Any, block: bool = True, timeout: Optional[float] = None) -> None:
         if not block:
-            if not api.get(self._actor.put_nowait.remote(item)):
+            if not api.get(self._actor.try_put.remote(item)):
                 raise Full("queue is full")
             return
-        if not api.get(self._actor.put.remote(item, timeout)):
-            raise Full("queue is full (timeout)")
+        self._poll(
+            lambda: (api.get(self._actor.try_put.remote(item)), None),
+            timeout,
+            Full("queue is full (timeout)"),
+        )
 
     def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
         if not block:
-            ok, item = api.get(self._actor.get_nowait.remote())
+            ok, item = api.get(self._actor.try_get.remote())
             if not ok:
                 raise Empty("queue is empty")
             return item
-        ok, item = api.get(self._actor.get.remote(timeout))
-        if not ok:
-            raise Empty("queue is empty (timeout)")
-        return item
+        return self._poll(
+            lambda: api.get(self._actor.try_get.remote()),
+            timeout,
+            Empty("queue is empty (timeout)"),
+        )
 
     def put_nowait(self, item: Any) -> None:
         self.put(item, block=False)
@@ -110,11 +122,14 @@ class Queue:
         return self.get(block=False)
 
     def put_nowait_batch(self, items: List[Any]) -> None:
-        for it in items:
-            self.put_nowait(it)
+        if not api.get(self._actor.try_put_batch.remote(list(items))):
+            raise Full(f"batch of {len(items)} does not fit")
 
     def get_nowait_batch(self, n: int) -> List[Any]:
-        return [self.get_nowait() for _ in range(n)]
+        ok, items = api.get(self._actor.try_get_batch.remote(n))
+        if not ok:
+            raise Empty(f"queue holds fewer than {n} items")
+        return items
 
     def qsize(self) -> int:
         return api.get(self._actor.qsize.remote())

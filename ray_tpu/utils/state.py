@@ -57,6 +57,13 @@ def cluster_stats() -> Dict[str, Any]:
     return _gcs().call("stats")
 
 
+def user_metrics() -> List[Dict[str, Any]]:
+    """Cluster-aggregated application metrics defined with
+    ray_tpu.utils.metrics Counter/Gauge/Histogram (reference:
+    ray.util.metrics surfaced through the dashboard/Prometheus)."""
+    return _gcs().call("user_metrics")
+
+
 def get_task(task_id: str) -> Optional[Dict[str, Any]]:
     return _gcs().call("get_task_states", [task_id]).get(task_id)
 

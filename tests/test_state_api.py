@@ -163,3 +163,40 @@ def test_timeline_export(rt_cluster, tmp_path):
 
     with open(out) as f:
         assert len(_json.load(f)) == len(events)
+
+
+def test_user_metrics_counter_gauge_histogram(rt_cluster):
+    """Application metrics flow worker -> GCS -> state API (reference:
+    ray.util.metrics + the stats exporter)."""
+    import time
+
+    rt = rt_cluster
+    from ray_tpu.utils import state
+
+    @rt.remote
+    def work(i):
+        from ray_tpu.utils import metrics
+
+        c = metrics.Counter("app_requests", tag_keys=("route",))
+        c.inc(2.0, tags={"route": "a"})
+        g = metrics.Gauge("app_depth")
+        g.set(float(i))
+        h = metrics.Histogram("app_latency", boundaries=[0.1, 1.0, 10.0])
+        h.observe(0.5)
+        metrics._flush_once()  # deterministic test: no 1s wait
+        return True
+
+    assert all(rt.get([work.remote(i) for i in range(3)], timeout=60))
+    deadline = time.time() + 10
+    found = {}
+    while time.time() < deadline:
+        found = {(m["name"], tuple(sorted(m["tags"].items()))): m for m in state.user_metrics()}
+        if ("app_requests", (("route", "a"),)) in found and ("app_latency", ()) in found:
+            break
+        time.sleep(0.2)
+    counter = found[("app_requests", (("route", "a"),))]
+    assert counter["value"] == 6.0  # 3 tasks x inc(2)
+    hist = found[("app_latency", ())]
+    assert sum(hist["counts"]) == 3 and hist["counts"][1] == 3  # all in (0.1, 1.0]
+    gauge = found[("app_depth", ())]
+    assert gauge["kind"] == "gauge" and gauge["value"] >= 0.0
