@@ -273,18 +273,21 @@ class GcsService:
         out: List[dict] = []
         with self._lock:
             for v in self._user_metrics.values():
+                if v["kind"] == "gauge":
+                    # A dead worker's last gauge value must not inflate the
+                    # cluster sum forever: reporters stale for 30 s are
+                    # PRUNED IN PLACE (worker churn would otherwise grow
+                    # the stored dict without bound), and only fresh ones
+                    # count (gauges re-report every flush interval).
+                    stale = [
+                        w for w, (_, ts) in v["gauges"].items() if now - ts >= 30.0
+                    ]
+                    for w in stale:
+                        del v["gauges"][w]
+                    v["value"] = sum(val for val, _ in v["gauges"].values())
                 entry = dict(v)
                 if entry["kind"] == "gauge":
-                    # A dead worker's last gauge value must not inflate the
-                    # cluster sum forever: only reporters fresh within 30 s
-                    # count (gauges re-report every flush interval).
-                    live = {
-                        w: val
-                        for w, (val, ts) in entry["gauges"].items()
-                        if now - ts < 30.0
-                    }
-                    entry["value"] = sum(live.values())
-                    entry["gauges"] = live
+                    entry["gauges"] = {w: val for w, (val, _) in v["gauges"].items()}
                 out.append(entry)
         return out
 

@@ -78,6 +78,8 @@ class _Dashboard:
                 return gcs.call("placement_group_table")
             if path == "stats":
                 return gcs.call("stats")
+            if path == "metrics":
+                return gcs.call("user_metrics")
             if path == "jobs":
                 from .jobs import list_job_records
 

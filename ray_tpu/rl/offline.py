@@ -89,6 +89,14 @@ class BC:
         )
         self.iteration = 0
 
+    def _make_batch(self, batch) -> Dict[str, np.ndarray]:
+        """Columns the loss consumes; subclasses extend (MARWIL adds the
+        return-to-go regression target)."""
+        return {
+            "obs": np.asarray(batch["obs"], np.float32),
+            "actions": np.asarray(batch["action"]),
+        }
+
     def train_on_dataset(self, dataset, *, epochs: int = 1) -> Dict[str, float]:
         """One or more passes over the dataset in batch_size minibatches."""
         metrics: Dict[str, float] = {}
@@ -96,11 +104,7 @@ class BC:
             for batch in dataset.iter_batches(
                 batch_size=self.config.batch_size, batch_format="numpy"
             ):
-                train_batch = {
-                    "obs": np.asarray(batch["obs"], np.float32),
-                    "actions": np.asarray(batch["action"]),
-                }
-                metrics = self.learner.update(train_batch)
+                metrics = self.learner.update(self._make_batch(batch))
                 self.iteration += 1
         if not metrics:
             raise ValueError("offline dataset produced no batches (empty after masking?)")
@@ -168,9 +172,11 @@ class MARWILConfig:
         return MARWIL(self)
 
 
-class MARWIL:
+class MARWIL(BC):
     """Monotonic Advantage Re-Weighted Imitation Learning over an offline
-    Dataset that carries return-to-go (rollouts_to_dataset provides it)."""
+    Dataset that carries return-to-go (rollouts_to_dataset provides it).
+    Shares BC's epoch/minibatch loop; only the loss and the batch columns
+    differ."""
 
     def __init__(self, config: MARWILConfig):
         import functools
@@ -182,22 +188,7 @@ class MARWIL:
         self.learner = JaxLearner(config.module, loss, lr=config.lr, seed=config.seed)
         self.iteration = 0
 
-    def train_on_dataset(self, dataset, *, epochs: int = 1) -> Dict[str, float]:
-        metrics: Dict[str, float] = {}
-        for _ in range(epochs):
-            for batch in dataset.iter_batches(
-                batch_size=self.config.batch_size, batch_format="numpy"
-            ):
-                train_batch = {
-                    "obs": np.asarray(batch["obs"], np.float32),
-                    "actions": np.asarray(batch["action"]),
-                    "returns": np.asarray(batch["return"], np.float32),
-                }
-                metrics = self.learner.update(train_batch)
-                self.iteration += 1
-        if not metrics:
-            raise ValueError("offline dataset produced no batches (empty after masking?)")
-        return metrics
-
-    def get_weights(self):
-        return self.learner.get_weights()
+    def _make_batch(self, batch) -> Dict[str, np.ndarray]:
+        out = super()._make_batch(batch)
+        out["returns"] = np.asarray(batch["return"], np.float32)
+        return out

@@ -30,23 +30,51 @@ def run(
     if not core_api.is_initialized():
         core_api.init(local_mode=True)
     controller = get_or_create_controller()
-    dep = target.deployment
+    _deploy_application(controller, target, name, cloudpickle)
+    if http_port is not None:
+        start_proxy(http_port)
+    return DeploymentHandle(name)
+
+
+def _deploy_application(controller, app: Application, name: str, cloudpickle) -> None:
+    """Deploys an application, recursively deploying bound inner
+    applications found in its init args and replacing them with
+    DeploymentHandles — deployment composition (reference: serve's
+    multi-deployment apps, `Outer.bind(Inner.bind())`; the inner DAG node
+    resolves to a handle inside the outer replica,
+    python/ray/serve/_private/build_app.py)."""
+
+    def resolve(value, slot: str):
+        if isinstance(value, Application):
+            inner_name = f"{name}-{value.deployment.name}-{slot}"
+            _deploy_application(controller, value, inner_name, cloudpickle)
+            return DeploymentHandle(inner_name)
+        # Applications nested in containers must resolve too — pickling
+        # one raw would surface as AttributeError at request time.
+        if isinstance(value, list):
+            return [resolve(v, f"{slot}.{i}") for i, v in enumerate(value)]
+        if isinstance(value, tuple):
+            return tuple(resolve(v, f"{slot}.{i}") for i, v in enumerate(value))
+        if isinstance(value, dict):
+            return {k: resolve(v, f"{slot}.{k}") for k, v in value.items()}
+        return value
+
+    init_args = tuple(resolve(a, f"a{i}") for i, a in enumerate(app.init_args))
+    init_kwargs = {k: resolve(v, k) for k, v in app.init_kwargs.items()}
+    dep = app.deployment
     asc = dep.config.autoscaling_config
     core_api.get(
         controller.deploy.remote(
             name,
             cloudpickle.dumps(dep.func_or_class),
-            target.init_args,
-            target.init_kwargs,
+            init_args,
+            init_kwargs,
             dep.config.num_replicas,
             dep.config.max_ongoing_requests,
             asc.__dict__ if asc else None,
             dep.config.ray_actor_options,
         )
     )
-    if http_port is not None:
-        start_proxy(http_port)
-    return DeploymentHandle(name)
 
 
 def get_app_handle(name: str = "default") -> DeploymentHandle:

@@ -130,6 +130,14 @@ class DeploymentHandle:
         self._lock = threading.Lock()
         self._refresh()
 
+    def __reduce__(self):
+        # Handles travel into replica constructors (deployment
+        # composition): rebuild from names at the destination — the
+        # resolved controller actor, lock, and replica cache are
+        # process-local (reference: serve handles are serializable and
+        # re-resolve server-side).
+        return (_rebuild_handle, (self._app, self._method, self._stream))
+
     def options(
         self, method_name: Optional[str] = None, stream: Optional[bool] = None
     ) -> "DeploymentHandle":
@@ -446,3 +454,9 @@ def stop_proxy() -> None:
     if _proxy is not None:
         _proxy.shutdown()
         _proxy = None
+
+
+def _rebuild_handle(app_name: str, method_name: str, stream: bool) -> "DeploymentHandle":
+    h = DeploymentHandle(app_name, method_name)
+    h._stream = stream
+    return h

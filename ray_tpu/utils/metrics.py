@@ -82,8 +82,10 @@ class Counter(Metric):
     kind = "counter"
 
     def __init__(self, name, description: str = "", tag_keys: Tuple[str, ...] = ()):
+        first = not getattr(self, "_initialized", False)
         super().__init__(name, description, tag_keys)
-        self._values: Dict[Tuple, float] = {}
+        if first:  # re-declaring the singleton must not wipe pending deltas
+            self._values: Dict[Tuple, float] = {}
 
     def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None) -> None:
         if value < 0:
@@ -108,8 +110,10 @@ class Gauge(Metric):
     kind = "gauge"
 
     def __init__(self, name, description: str = "", tag_keys: Tuple[str, ...] = ()):
+        first = not getattr(self, "_initialized", False)
         super().__init__(name, description, tag_keys)
-        self._values: Dict[Tuple, float] = {}
+        if first:
+            self._values: Dict[Tuple, float] = {}
 
     def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
         k = _tags_key(self._merged(tags))
@@ -138,12 +142,14 @@ class Histogram(Metric):
         boundaries: Optional[List[float]] = None,
         tag_keys: Tuple[str, ...] = (),
     ):
+        first = not getattr(self, "_initialized", False)
         super().__init__(name, description, tag_keys)
-        if not boundaries:
-            raise ValueError("Histogram requires explicit bucket boundaries")
-        self.boundaries = sorted(float(b) for b in boundaries)
-        self._counts: Dict[Tuple, List[int]] = {}
-        self._sums: Dict[Tuple, float] = {}
+        if first:
+            if not boundaries:
+                raise ValueError("Histogram requires explicit bucket boundaries")
+            self.boundaries = sorted(float(b) for b in boundaries)
+            self._counts: Dict[Tuple, List[int]] = {}
+            self._sums: Dict[Tuple, float] = {}
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
         k = _tags_key(self._merged(tags))

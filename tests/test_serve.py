@@ -280,3 +280,29 @@ def test_grpc_proxy_unary_and_streaming(rt):
         channel.close()
     finally:
         stop_grpc_proxy()
+
+
+def test_deployment_composition(rt):
+    """Outer.bind(Inner.bind()): the inner app deploys automatically and
+    the outer replica receives a working DeploymentHandle (reference:
+    serve multi-deployment applications)."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Tokenizer:
+        def __call__(self, text):
+            return text.split()
+
+    @serve.deployment(num_replicas=2)
+    class Pipeline:
+        def __init__(self, tokenizer):
+            self.tokenizer = tokenizer
+
+        def __call__(self, text):
+            tokens = self.tokenizer.remote(text).result(timeout=30)
+            return {"n_tokens": len(tokens), "tokens": tokens}
+
+    handle = serve.run(Pipeline.bind(Tokenizer.bind()), name="composed")
+    out = handle.remote("the quick brown fox").result(timeout=60)
+    assert out == {"n_tokens": 4, "tokens": ["the", "quick", "brown", "fox"]}
+    serve.shutdown()
