@@ -41,6 +41,8 @@ from .multi_agent import (
 )
 from .ppo import PPO, PPOConfig, compute_gae, ppo_loss
 from .replay import TransitionReplayBuffer
+from .cql import CQL, CQLConfig
+from .offline import rollouts_to_transitions
 from .sac import SAC, SACConfig, SquashedGaussianModule
 
 __all__ = [
@@ -55,4 +57,5 @@ __all__ = [
     "MARWIL", "MARWILConfig", "marwil_loss",
     "rollouts_to_dataset", "Connector", "ConnectorPipeline", "FlattenObs",
     "ClipObs", "NormalizeObs", "SAC", "SACConfig", "SquashedGaussianModule",
+    "CQL", "CQLConfig", "rollouts_to_transitions",
 ]

@@ -1,0 +1,230 @@
+"""CQL: conservative Q-learning for offline continuous control.
+
+Re-design of the reference's CQL (reference: rllib/algorithms/cql/cql.py —
+SAC plus the CQL(H) conservative regularizer on the critics; loss in
+cql_torch_policy/cql_torch_learner). Purely offline: no env runners, the
+algorithm consumes a transition dataset (obs, actions, rewards, next_obs,
+terminateds). The whole step (regularized twin critics + actor + learned
+temperature + polyak targets) is ONE jitted function.
+
+The conservative term lower-bounds the learned Q: for each state,
+logsumexp over Q at sampled actions (uniform + current-policy, the CQL(H)
+importance-sampling estimator) is pushed DOWN while Q at dataset actions
+is pushed UP — out-of-distribution actions cannot look spuriously good.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sac import SquashedGaussianModule
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class CQLConfig:
+    """(reference: cql.py CQLConfig — min_q_weight here is cql_alpha)"""
+
+    obs_dim: int = None
+    act_dim: int = None
+    action_low: float = -1.0
+    action_high: float = 1.0
+    cql_alpha: float = 1.0          # weight of the conservative term
+    n_action_samples: int = 8       # actions per state in the logsumexp
+    gamma: float = 0.99
+    tau: float = 0.005
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    target_entropy: Optional[float] = None
+    hidden: Tuple[int, ...] = (256, 256)
+    batch_size: int = 256
+    seed: int = 0
+
+    def build(self) -> "CQL":
+        return CQL(self)
+
+
+class CQL:
+    def __init__(self, config: CQLConfig):
+        import optax
+
+        if config.obs_dim is None or config.act_dim is None:
+            raise ValueError("CQLConfig needs obs_dim and act_dim")
+        self.config = config
+        self.module = SquashedGaussianModule(
+            config.obs_dim,
+            config.act_dim,
+            hidden=config.hidden,
+            low=config.action_low,
+            high=config.action_high,
+        )
+        self.target_entropy = (
+            config.target_entropy
+            if config.target_entropy is not None
+            else -float(config.act_dim)
+        )
+        key = jax.random.PRNGKey(config.seed)
+        self.params = self.module.init_params(key)
+        self.target_q = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self._tx = {
+            "pi": optax.adam(config.actor_lr),
+            "q": optax.adam(config.critic_lr),
+            "alpha": optax.adam(config.alpha_lr),
+        }
+        self._opt = {
+            "pi": self._tx["pi"].init(self.params["pi"]),
+            "q": self._tx["q"].init({"q1": self.params["q1"], "q2": self.params["q2"]}),
+            "alpha": self._tx["alpha"].init(self.params["log_alpha"]),
+        }
+        self._key = jax.random.PRNGKey(config.seed + 1)
+        self._update = jax.jit(self._update_impl)
+        self.num_updates = 0
+
+    # ------------------------------------------------------------- update
+    def _q_both(self, qs, obs, act):
+        m = self.module
+        return m.q_value(qs["q1"], obs, act), m.q_value(qs["q2"], obs, act)
+
+    def _update_impl(self, params, target_q, opt, key, batch):
+        import optax
+
+        cfg = self.config
+        m = self.module
+        obs, act = batch["obs"], batch["actions"]
+        B = obs.shape[0]
+        k_next, k_pi, k_unif, k_cur = jax.random.split(key, 4)
+
+        # ---- SAC critic target
+        next_a, next_logp = m.pi_sample(params, k_next, batch["next_obs"])
+        alpha = jnp.exp(params["log_alpha"])
+        q_next = jnp.minimum(
+            m.q_value(target_q["q1"], batch["next_obs"], next_a),
+            m.q_value(target_q["q2"], batch["next_obs"], next_a),
+        )
+        target = batch["rewards"] + cfg.gamma * (1.0 - batch["terminateds"]) * (
+            q_next - alpha * next_logp
+        )
+        target = jax.lax.stop_gradient(target)
+
+        # Sampled actions for the conservative logsumexp: half uniform over
+        # the action box, half from the current policy (the CQL(H)
+        # importance-sampling mix), with their log-densities.
+        N = cfg.n_action_samples
+        lo = m.center - m.scale
+        hi = m.center + m.scale
+        unif = jax.random.uniform(
+            k_unif, (N, B, cfg.act_dim), minval=lo, maxval=hi
+        ).astype(obs.dtype)
+        unif_logp = -jnp.sum(jnp.log(hi - lo))  # scalar log-density
+        cur_keys = jax.random.split(k_cur, N)
+        cur_a, cur_logp = jax.vmap(
+            lambda kk: m.pi_sample(params, kk, obs)
+        )(cur_keys)  # [N, B, act], [N, B]
+        cur_a = jax.lax.stop_gradient(cur_a)
+        cur_logp = jax.lax.stop_gradient(cur_logp)
+
+        def q_loss_fn(qs):
+            q1d, q2d = self._q_both(qs, obs, act)
+            bellman = jnp.mean((q1d - target) ** 2) + jnp.mean((q2d - target) ** 2)
+
+            def q_at(actions):  # [N, B, act] -> ([N, B], [N, B])
+                f = lambda a: self._q_both(qs, obs, a)
+                return jax.vmap(f)(actions)
+
+            u1, u2 = q_at(unif)
+            c1, c2 = q_at(cur_a)
+            # Importance-corrected logsumexp over the 2N samples.
+            cat1 = jnp.concatenate([u1 - unif_logp, c1 - cur_logp], axis=0)
+            cat2 = jnp.concatenate([u2 - unif_logp, c2 - cur_logp], axis=0)
+            lse1 = jax.scipy.special.logsumexp(cat1, axis=0) - jnp.log(2 * N)
+            lse2 = jax.scipy.special.logsumexp(cat2, axis=0) - jnp.log(2 * N)
+            conservative = jnp.mean(lse1 - q1d) + jnp.mean(lse2 - q2d)
+            return bellman + cfg.cql_alpha * conservative, (bellman, conservative)
+
+        qs = {"q1": params["q1"], "q2": params["q2"]}
+        (q_loss, (bellman, conservative)), q_grads = jax.value_and_grad(
+            q_loss_fn, has_aux=True
+        )(qs)
+        q_updates, opt_q = self._tx["q"].update(q_grads, opt["q"], qs)
+        qs = optax.apply_updates(qs, q_updates)
+
+        # ---- actor (standard SAC objective against the new critics)
+        def pi_loss_fn(pi):
+            a, logp = m.pi_sample({**params, "pi": pi}, k_pi, obs)
+            q = jnp.minimum(m.q_value(qs["q1"], obs, a), m.q_value(qs["q2"], obs, a))
+            return jnp.mean(alpha * logp - q), logp
+
+        (pi_loss, logp), pi_grads = jax.value_and_grad(pi_loss_fn, has_aux=True)(
+            params["pi"]
+        )
+        pi_updates, opt_pi = self._tx["pi"].update(pi_grads, opt["pi"], params["pi"])
+        new_pi = optax.apply_updates(params["pi"], pi_updates)
+
+        # ---- temperature
+        def alpha_loss_fn(log_alpha):
+            return -jnp.mean(
+                jnp.exp(log_alpha) * jax.lax.stop_gradient(logp + self.target_entropy)
+            )
+
+        a_loss, a_grad = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"])
+        a_update, opt_a = self._tx["alpha"].update(a_grad, opt["alpha"], params["log_alpha"])
+        new_log_alpha = optax.apply_updates(params["log_alpha"], a_update)
+
+        new_target = jax.tree_util.tree_map(
+            lambda t, o: (1 - cfg.tau) * t + cfg.tau * o, target_q, qs
+        )
+        new_params = {
+            "pi": new_pi, "q1": qs["q1"], "q2": qs["q2"], "log_alpha": new_log_alpha,
+        }
+        new_opt = {"pi": opt_pi, "q": opt_q, "alpha": opt_a}
+        metrics = {
+            "q_loss": q_loss,
+            "bellman_loss": bellman,
+            "cql_conservative": conservative,
+            "pi_loss": pi_loss,
+            "alpha_loss": a_loss,
+        }
+        return new_params, new_target, new_opt, metrics
+
+    # -------------------------------------------------------------- train
+    def train_on_dataset(self, dataset, *, epochs: int = 1) -> Dict[str, float]:
+        """Offline passes over a transition dataset with columns
+        obs/action/reward/next_obs/done."""
+        metrics: Dict[str, float] = {}
+        for _ in range(epochs):
+            for batch in dataset.iter_batches(
+                batch_size=self.config.batch_size, batch_format="numpy"
+            ):
+                self._key, sub = jax.random.split(self._key)
+                train_batch = {
+                    "obs": np.asarray(batch["obs"], np.float32),
+                    "actions": np.asarray(batch["action"], np.float32),
+                    "rewards": np.asarray(batch["reward"], np.float32),
+                    "next_obs": np.asarray(batch["next_obs"], np.float32),
+                    "terminateds": np.asarray(batch["done"], np.float32),
+                }
+                self.params, self.target_q, self._opt, out = self._update(
+                    self.params, self.target_q, self._opt, sub, train_batch
+                )
+                self.num_updates += 1
+                metrics = {k: float(v) for k, v in out.items()}
+        if not metrics:
+            raise ValueError("offline dataset produced no batches")
+        return metrics
+
+    def q_values(self, obs: np.ndarray, actions: np.ndarray) -> np.ndarray:
+        """Min of the twin critics (for offline evaluation)."""
+        q1 = self.module.q_value(self.params["q1"], obs, actions)
+        q2 = self.module.q_value(self.params["q2"], obs, actions)
+        return np.asarray(jnp.minimum(q1, q2))
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        out = self.module.forward_inference(self.params, jnp.asarray(obs, jnp.float32))
+        return np.asarray(jnp.tanh(out["mean"]) * self.module.scale + self.module.center)

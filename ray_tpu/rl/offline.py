@@ -192,3 +192,46 @@ class MARWIL(BC):
         out = super()._make_batch(batch)
         out["returns"] = np.asarray(batch["return"], np.float32)
         return out
+
+
+def rollouts_to_transitions(rollouts: Iterable[Dict[str, np.ndarray]]):
+    """Transition-level dataset (obs, action, reward, next_obs, done) for
+    off-policy offline algorithms (CQL). next_obs is the following step's
+    observation. A rollout's final step is kept when `done` terminates it
+    (the target needs no bootstrap, and terminal steps often carry the
+    reward) with a zero next_obs placeholder; an unterminated final step
+    is dropped (its bootstrap target is unknown). Honors the optional
+    per-step `mask` like rollouts_to_dataset."""
+    cols: Dict[str, List[np.ndarray]] = {
+        "obs": [], "action": [], "reward": [], "next_obs": [], "done": []
+    }
+    for ro in rollouts:
+        obs = np.asarray(ro["obs"], np.float32)
+        act = np.asarray(ro["actions"], np.float32)
+        T, N = act.shape[:2]
+        rewards = np.asarray(ro["rewards"], np.float32).reshape(T, N)
+        dones = np.asarray(ro["dones"], np.float32).reshape(T, N)
+        mask = ro.get("mask")
+        valid = (
+            np.asarray(mask, np.float32).reshape(T, N) != 0.0
+            if mask is not None
+            else np.ones((T, N), bool)
+        )
+        # Steps 0..T-2 pair with the next step; step T-1 survives only
+        # where done — its next_obs placeholder is never used (done=1
+        # zeroes the bootstrap).
+        next_obs = np.concatenate([obs[1:], np.zeros_like(obs[:1])], axis=0)
+        keep = valid.copy()
+        keep[T - 1] &= dones[T - 1] != 0.0
+        flat_keep = keep.reshape(-1)
+
+        def flat(x):
+            return x.reshape((-1,) + x.shape[2:])[flat_keep]
+
+        cols["obs"].append(flat(obs))
+        cols["action"].append(flat(act))
+        cols["reward"].append(rewards.reshape(-1)[flat_keep])
+        cols["next_obs"].append(flat(next_obs))
+        cols["done"].append(dones.reshape(-1)[flat_keep])
+    merged = {k: np.concatenate(v) if v else np.zeros((0,)) for k, v in cols.items()}
+    return ds.from_numpy(merged)

@@ -36,18 +36,29 @@ def run(
     return DeploymentHandle(name)
 
 
-def _deploy_application(controller, app: Application, name: str, cloudpickle) -> None:
+def _deploy_application(
+    controller, app: Application, name: str, cloudpickle, _seen=None
+) -> None:
     """Deploys an application, recursively deploying bound inner
     applications found in its init args and replacing them with
     DeploymentHandles — deployment composition (reference: serve's
     multi-deployment apps, `Outer.bind(Inner.bind())`; the inner DAG node
     resolves to a handle inside the outer replica,
-    python/ray/serve/_private/build_app.py)."""
+    python/ray/serve/_private/build_app.py). A shared inner Application
+    bound into multiple slots deploys ONCE (like the reference's shared
+    DAG nodes); inner app names are recorded as children so delete()
+    cascades."""
+    seen: dict = {} if _seen is None else _seen  # id(Application) -> name
+    children: list = []
 
     def resolve(value, slot: str):
         if isinstance(value, Application):
-            inner_name = f"{name}-{value.deployment.name}-{slot}"
-            _deploy_application(controller, value, inner_name, cloudpickle)
+            inner_name = seen.get(id(value))
+            if inner_name is None:
+                inner_name = f"{name}-{value.deployment.name}-{slot}"
+                seen[id(value)] = inner_name
+                _deploy_application(controller, value, inner_name, cloudpickle, seen)
+                children.append(inner_name)
             return DeploymentHandle(inner_name)
         # Applications nested in containers must resolve too — pickling
         # one raw would surface as AttributeError at request time.
@@ -73,6 +84,7 @@ def _deploy_application(controller, app: Application, name: str, cloudpickle) ->
             dep.config.max_ongoing_requests,
             asc.__dict__ if asc else None,
             dep.config.ray_actor_options,
+            children,
         )
     )
 

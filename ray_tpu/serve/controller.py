@@ -192,6 +192,7 @@ class ServeController:
         max_ongoing: int,
         autoscaling: Optional[dict],
         actor_options: Dict[str, Any],
+        children: Optional[List[str]] = None,
     ) -> bool:
         with self._lock:
             redeploy = app_name in self._apps
@@ -204,6 +205,9 @@ class ServeController:
                 "max_ongoing": max_ongoing,
                 "autoscaling": autoscaling,
                 "actor_options": actor_options,
+                # Composition-created inner apps: delete cascades to them
+                # (they exist only to serve this app).
+                "children": list(children or []),
             }
             # Redeploy replaces the code: existing replicas run the OLD
             # blob and must be torn down so the reconciler rebuilds them
@@ -221,7 +225,7 @@ class ServeController:
 
     def delete_app(self, app_name: str) -> bool:
         with self._lock:
-            self._apps.pop(app_name, None)
+            spec = self._apps.pop(app_name, None)
             replicas = self._replicas.pop(app_name, [])
             self._app_gen[app_name] = self._app_gen.get(app_name, 0) + 1
             self._version += 1
@@ -230,6 +234,10 @@ class ServeController:
                 api.kill(r)
             except Exception:
                 pass
+        # Cascade to composition-created inner apps: deleting only the
+        # outer app would leak their replica actors.
+        for child in (spec or {}).get("children", []):
+            self.delete_app(child)
         return True
 
     # ---------------------------------------------------------- reconcile

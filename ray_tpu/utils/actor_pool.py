@@ -53,14 +53,18 @@ class ActorPool:
         ref = self._index_to_future[idx]
         try:
             # Fetch BEFORE consuming bookkeeping: a timeout (or an
-            # interrupt/infra error — the task may still be running) must
-            # leave the result claimable by a retrying get_next.
+            # interrupt — the task may still be running) must leave the
+            # result claimable by a retrying get_next.
             value = api.get(ref, timeout=timeout)
-        except (exceptions.TaskError, exceptions.ActorError, exceptions.WorkerCrashedError):
-            # The TASK terminally failed: its result is consumed
-            # (re-raising here is the delivery) and its actor is free
-            # again — without this, one raising task permanently leaks
-            # its actor from the pool.
+        except exceptions.GetTimeoutError:
+            raise
+        except exceptions.RayTpuError:
+            # Any other framework error is TERMINAL for this submission
+            # (task raised / cancelled / object lost / worker crashed):
+            # its result is consumed (re-raising here is the delivery) and
+            # its actor is free again — without this, one failing task
+            # permanently leaks its actor from the pool and has_next()
+            # livelocks.
             del self._index_to_future[idx]
             self._next_return_index = idx + 1
             self._release(ref)

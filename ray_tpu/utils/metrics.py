@@ -143,10 +143,13 @@ class Histogram(Metric):
         tag_keys: Tuple[str, ...] = (),
     ):
         first = not getattr(self, "_initialized", False)
+        if first and not boundaries:
+            # Validate BEFORE registration: a half-registered Histogram
+            # (cached singleton, no state) would break every later
+            # re-declaration and crash the flusher.
+            raise ValueError("Histogram requires explicit bucket boundaries")
         super().__init__(name, description, tag_keys)
         if first:
-            if not boundaries:
-                raise ValueError("Histogram requires explicit bucket boundaries")
             self.boundaries = sorted(float(b) for b in boundaries)
             self._counts: Dict[Tuple, List[int]] = {}
             self._sums: Dict[Tuple, float] = {}
@@ -199,7 +202,10 @@ def _flush_once() -> None:
         metrics = list(_registry)
         records, _pending_records = _pending_records, []
     for m in metrics:
-        records.extend(m._collect())
+        try:
+            records.extend(m._collect())
+        except Exception:
+            pass  # one broken metric must not kill the process flusher
     if records:
         try:
             gcs.call("report_metrics", getattr(rt, "_worker_id", "?"), records)

@@ -579,3 +579,46 @@ def test_rollouts_to_dataset_return_to_go():
     ds = rollouts_to_dataset([rollout], gamma=0.5)
     rets = [r["return"] for r in ds.take_all()]
     assert rets == [1.0 + 0.5 * (1.0 + 0.5), 1.5, 1.0]
+
+
+def test_cql_is_conservative_on_ood_actions(rt):
+    """After offline training on a narrow behavior policy, CQL's learned Q
+    must score out-of-distribution random actions BELOW the dataset
+    actions (the conservative lower-bound property; reference:
+    rllib/algorithms/cql)."""
+    from ray_tpu.rl import CQL, CQLConfig
+    from ray_tpu.rl.offline import rollouts_to_transitions
+
+    rng = np.random.RandomState(0)
+    T, N, obs_dim, act_dim = 40, 8, 3, 1
+    obs = rng.randn(T, N, obs_dim).astype(np.float32)
+    # Behavior policy: small actions near +0.5 with reward favoring them.
+    actions = (0.5 + 0.05 * rng.randn(T, N, act_dim)).astype(np.float32).clip(-1, 1)
+    rewards = (1.0 - np.abs(actions[..., 0] - 0.5)).astype(np.float32)
+    rollout = {
+        "obs": obs,
+        "actions": actions,
+        "rewards": rewards,
+        "dones": np.zeros((T, N), np.float32),
+    }
+    dataset = rollouts_to_transitions([rollout])
+    assert dataset.count() == (T - 1) * N
+
+    algo = CQLConfig(
+        obs_dim=obs_dim, act_dim=act_dim, cql_alpha=5.0,
+        n_action_samples=4, batch_size=64, seed=0,
+    ).build()
+    for _ in range(6):
+        metrics = algo.train_on_dataset(dataset)
+    assert np.isfinite(metrics["q_loss"])
+    assert "cql_conservative" in metrics
+
+    eval_obs = obs[:-1].reshape(-1, obs_dim)[:128]
+    data_act = actions[:-1].reshape(-1, act_dim)[:128]
+    ood_act = rng.uniform(-1.0, -0.6, size=data_act.shape).astype(np.float32)
+    q_data = algo.q_values(eval_obs, data_act).mean()
+    q_ood = algo.q_values(eval_obs, ood_act).mean()
+    assert q_ood < q_data, f"CQL not conservative: ood {q_ood} >= data {q_data}"
+
+    acts = algo.compute_actions(eval_obs[:4])
+    assert acts.shape == (4, act_dim) and np.all(np.abs(acts) <= 1.0)
