@@ -57,7 +57,11 @@ def main() -> None:
     # the old 512-wide flash blocks): lower HBM pressure pipelines the
     # full step better; MFU is not monotone in batch.
     ap.add_argument("--batch", type=int, default=4)
-    ap.add_argument("--attn", default="full")
+    ap.add_argument("--attn", default="full", choices=["full", "naive", "ring", "ulysses"])
+    # Long-context mode: --seq 32k runs the flagship at that context with
+    # batch 1 (tokens/s + MFU at long context; pairs with --attn ring to
+    # exercise the sequence-parallel path end to end). Accepts "32k"/"32768".
+    ap.add_argument("--seq", default=None)
     ap.add_argument("--steps", type=int, default=10)
     # 350m fits (with optimizer state) on ONE v5e chip; 7b needs a sharded
     # mesh — params+adam alone are ~84 GB fp32-equivalent vs 16 GB HBM —
@@ -85,7 +89,13 @@ def main() -> None:
         )
     d_model, n_layers, n_heads, d_ff, vocab = model_shapes[args.model]
 
+    def parse_seq(s):
+        s = s.lower().strip()
+        return int(s[:-1]) * 1024 if s.endswith("k") else int(s)
+
+    long_ctx = args.seq is not None
     if on_tpu:
+        seq = parse_seq(args.seq) if long_ctx else 2048
         cfg = tfm.TransformerConfig(
             vocab_size=vocab,
             d_model=d_model,
@@ -93,16 +103,32 @@ def main() -> None:
             n_heads=n_heads,
             n_kv_heads=n_heads,
             d_ff=d_ff,
-            max_seq_len=2048,
+            max_seq_len=seq,
             dtype=jnp.bfloat16,
             remat=True,
             remat_policy=None if args.remat_policy == "none" else args.remat_policy,
             attn_impl=args.attn,
         )
-        batch, seq, steps, warmup = args.batch, 2048, args.steps, 2
+        batch = 1 if (long_ctx and args.batch == 4) else args.batch
+        steps, warmup = args.steps, 2
     else:  # smoke-test shape for CPU runs
+        seq = parse_seq(args.seq) if long_ctx else 64
         cfg = tfm.tiny(dtype=jnp.float32)
-        batch, seq, steps, warmup = 2, 64, 3, 1
+        cfg = tfm.TransformerConfig(
+            **{**cfg.__dict__, "max_seq_len": seq, "attn_impl": args.attn}
+        )
+        batch, steps, warmup = 1 if long_ctx else 2, 3, 1
+
+    # Sequence-parallel attention runs over a "seq" mesh axis spanning all
+    # visible devices (one real chip -> degenerate 1-ring, still the flash
+    # path; the 8-device CPU mesh exercises the real ring/all-to-all).
+    mesh = None
+    if args.attn in ("ring", "ulysses"):
+        import numpy as _np
+        from jax.sharding import Mesh
+
+        devs = _np.array(jax.devices())
+        mesh = Mesh(devs.reshape(-1), ("seq",))
 
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
     tx = optax.adamw(1e-4)
@@ -113,7 +139,7 @@ def main() -> None:
     # traffic and footprint for the update.
     @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(tfm.next_token_loss)(params, tokens, cfg)
+        loss, grads = jax.value_and_grad(tfm.next_token_loss)(params, tokens, cfg, mesh)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -136,7 +162,13 @@ def main() -> None:
                 # Off-TPU runs benchmark the tiny smoke model, never the
                 # named architecture — the metric must say so.
                 "metric": (
-                    f"llama{args.model}_train_mfu_1chip" if on_tpu else "tiny_smoke_mfu_cpu"
+                    (
+                        f"llama{args.model}_train_mfu_{seq//1024}k_{args.attn}"
+                        if long_ctx
+                        else f"llama{args.model}_train_mfu_1chip"
+                    )
+                    if on_tpu
+                    else "tiny_smoke_mfu_cpu"
                 ),
                 "value": round(mfu, 4),
                 "unit": "mfu_fraction",

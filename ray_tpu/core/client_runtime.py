@@ -46,9 +46,11 @@ class _RemoteStoreProxy:
 
     # -- reads -----------------------------------------------------------
     def get(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
-        raw = self._raylet.call(
-            "client_get", oid.hex(), timeout or 0.0, timeout=(timeout or 0.0) + 15.0
-        )
+        # timeout=None means "wait" on the store surface: give the server
+        # a real window (callers loop); 0.0 would KeyError anything not
+        # already resident on the gateway.
+        window = 5.0 if timeout is None else timeout
+        raw = self._raylet.call("client_get", oid.hex(), window, timeout=window + 15.0)
         if raw is None:
             raise KeyError(oid.hex())
         return serialization.unpack(raw)

@@ -141,6 +141,25 @@ class ClusterRuntime(Runtime):
         self._submit_buf: List[dict] = []
         self._submit_wake = threading.Event()
         threading.Thread(target=self._submit_loop, daemon=True, name="submit").start()
+        # Leased-worker fast path (direct owner->worker pushes; reference:
+        # normal_task_submitter.cc:555 PushTask on a cached lease) and
+        # per-actor ordered direct channels.
+        from .fastpath import FastPath
+
+        self._fastpath = FastPath(self)
+        self._actor_channels: Dict[str, Any] = {}
+        self._actor_channels_lock = threading.Lock()
+        self._cancelled_tids: set = set()
+        # Fast-path completion wakeups: the worker's in-band ack marks the
+        # outputs sealed, waking local get()s milliseconds before the
+        # batched raylet/GCS notification lands.
+        self._fast_pending: set = set()
+        self._fast_seal_cv = threading.Condition()
+        # Owner memory store: small direct-task results live here, never
+        # touching shm or the GCS directory (reference: the CoreWorker
+        # in-memory store, src/ray/core_worker/store_provider/memory_store/).
+        self._memstore: Dict[str, bytes] = {}
+        self._memstore_bytes = 0
         # Stream worker stdout/stderr to the driver console (reference:
         # log_monitor.py tailing worker logs to the driver; disable with
         # RAY_TPU_LOG_TO_DRIVER=0). Remote clients (tcp:// raylet, no
@@ -156,6 +175,41 @@ class ClusterRuntime(Runtime):
             threading.Thread(
                 target=self._stream_logs, daemon=True, name="logmon"
             ).start()
+
+    def _fast_register(self, entry: dict) -> None:
+        with self._fast_seal_cv:
+            self._fast_pending.update(entry["return_ids"])
+
+    def _fast_sealed(self, sealed: List[str], inline: Optional[dict] = None) -> None:
+        """Completion ack from a direct worker: record inline results in
+        the owner's memory store (reference: CoreWorker's in-memory store
+        for small returns — memory_store.h) and wake local waiters."""
+        if inline:
+            memstore = self._memstore
+            for h, blob in inline.items():
+                with self._ref_lock:
+                    wanted = h in self._owned
+                if not wanted:
+                    # Every ref was dropped while the task was in flight
+                    # (fire-and-forget): storing the late result would leak
+                    # it forever — nothing will ever free this hex again.
+                    continue
+                if self._memstore_bytes + len(blob) > 256 << 20:
+                    # Memory-store cap: overflow objects go to shm where
+                    # the normal eviction/spill machinery owns them.
+                    try:
+                        self._store.put_raw(ObjectID.from_hex(h), blob)
+                        self._raylet.notify("notify_object", h)
+                        continue
+                    except Exception:
+                        pass
+                memstore[h] = blob
+                self._memstore_bytes += len(blob)
+        with self._fast_seal_cv:
+            self._fast_pending.difference_update(sealed)
+            if inline:
+                self._fast_pending.difference_update(inline.keys())
+            self._fast_seal_cv.notify_all()
 
     def _stream_logs(self) -> None:
         log_dir = os.path.join(self._log_session, "logs")
@@ -286,8 +340,28 @@ class ClusterRuntime(Runtime):
             self._free_wake.set()
 
     def mark_escaped(self, object_id: ObjectID) -> None:
+        h = object_id.hex()
         with self._ref_lock:
-            self._escaped.add(object_id.hex())
+            self._escaped.add(h)
+        blob = self._memstore.get(h)
+        if blob is not None:
+            # The ref is leaving this process: another worker may need the
+            # value, so the memory-store object is promoted to shm and the
+            # directory learns its location (reference: in-memory objects
+            # are promoted to plasma when borrowed across processes).
+            try:
+                self._store.put_raw(object_id, blob)
+            except exc.ObjectStoreFullError:
+                try:
+                    self._raylet.call("ensure_space", len(blob))
+                    self._store.put_raw(object_id, blob)
+                except Exception:
+                    return  # keep it in memory; gets still work locally
+            except Exception:
+                return
+            self._raylet.notify("notify_object", h)
+            self._memstore_bytes -= len(blob)
+            self._memstore.pop(h, None)
 
     def remove_local_ref(self, object_id: ObjectID) -> None:
         freed = False
@@ -312,6 +386,36 @@ class ClusterRuntime(Runtime):
                     continue
                 self._owned.discard(h)
                 rec = self._records.pop(h, None)
+                mem_blob = (
+                    self._memstore.pop(h, None) if h not in self._escaped else None
+                )
+                if mem_blob is not None:
+                    # Inline result never left this process: dropping the
+                    # dict entry IS the free — no pool block, no GCS
+                    # directory entry, no cluster-wide cleanup. (Escaped
+                    # objects never take this branch: a borrower may still
+                    # need the value, so they ride the GCS borrow path; a
+                    # memstore-only escaped object was promoted to shm by
+                    # mark_escaped, or, if that promotion failed, by the
+                    # retry below.)
+                    self._memstore_bytes -= len(mem_blob)
+                    freed = True
+                    if rec is not None and not any(
+                        self._records.get(r) is rec for r in rec.entry["return_ids"]
+                    ):
+                        if rec.entry.get("deps"):
+                            self._dropped_records.append(rec)
+                    continue
+                if h in self._escaped and h in self._memstore:
+                    # Escaped but promotion failed at escape time: retry so
+                    # the shm copy exists before our in-memory one goes.
+                    try:
+                        self._store.put_raw(ObjectID.from_hex(h), self._memstore[h])
+                        self._raylet.notify("notify_object", h)
+                        blob2 = self._memstore.pop(h)
+                        self._memstore_bytes -= len(blob2)
+                    except Exception:
+                        pass  # keep the blob; better a leak than data loss
                 if h not in self._escaped:
                     # No other process can hold a borrow (the ref never left
                     # this one): free the pool block now so the allocator
@@ -457,7 +561,16 @@ class ClusterRuntime(Runtime):
 
     def _get_one(self, oid: ObjectID, deadline: Optional[float]) -> Any:
         h = oid.hex()
+        fast_until: Optional[float] = None
         while True:
+            blob = self._memstore.get(h)
+            if blob is not None:
+                from . import serialization
+
+                value = serialization.unpack(blob)
+                if isinstance(value, StoredError):
+                    raise value.error
+                return value
             if self._store.contains(oid):
                 value = self._store.get(oid, timeout=5.0)
                 if isinstance(value, StoredError):
@@ -466,6 +579,20 @@ class ClusterRuntime(Runtime):
             remaining = None if deadline is None else deadline - time.monotonic()
             if remaining is not None and remaining <= 0:
                 raise exc.GetTimeoutError(f"get() timed out for {oid.hex()[:12]}")
+            if h in self._fast_pending:
+                # In flight on a direct connection: the completion ack wakes
+                # this wait — no RPC. After ~5s of true silence (wall time,
+                # not wakeups — ack storms wake every waiter constantly) we
+                # fall through to the raylet path as a safety net.
+                now = time.monotonic()
+                if fast_until is None:
+                    fast_until = now + 5.0
+                if now < fast_until:
+                    with self._fast_seal_cv:
+                        if h in self._fast_pending:
+                            self._fast_seal_cv.wait(timeout=0.05)
+                    continue
+            fast_until = None
             poll = CONFIG.object_wait_poll_s
             if remaining is not None:
                 poll = max(0.05, min(poll, remaining))
@@ -488,13 +615,37 @@ class ClusterRuntime(Runtime):
         ids = list(object_ids)
         hexes = [oid.hex() for oid in ids]
         while True:
+            # Inline results live in the owner's memory store only — the
+            # raylet has never heard of them.
+            mem_ready = {h for h in hexes if h in self._memstore}
+            if len(mem_ready) >= num_returns:
+                ready_h = mem_ready
+                break
+            pending_fast = [h for h in hexes if h in self._fast_pending]
+            if pending_fast and len(mem_ready) + len(
+                [h for h in hexes if self._store.contains(ObjectID.from_hex(h))]
+            ) < num_returns:
+                # Direct tasks in flight: wait on the ack wakeup first.
+                with self._fast_seal_cv:
+                    self._fast_seal_cv.wait(timeout=0.05)
+                if deadline is not None and time.monotonic() >= deadline:
+                    ready_h = mem_ready | {
+                        h for h in hexes if self._store.contains(ObjectID.from_hex(h))
+                    }
+                    break
+                continue
             remaining = None if deadline is None else deadline - time.monotonic()
             poll = CONFIG.object_wait_poll_s
             if remaining is not None:
                 poll = max(0.0, min(poll, remaining))
-            ready_h = set(
+            ready_h = mem_ready | set(
                 self._raylet.call(
-                    "wait_objects", hexes, num_returns, poll, False, timeout=poll + 10.0
+                    "wait_objects",
+                    [h for h in hexes if h not in mem_ready],
+                    max(0, num_returns - len(mem_ready)),
+                    poll,
+                    False,
+                    timeout=poll + 10.0,
                 )
             )
             if len(ready_h) >= num_returns or (
@@ -582,7 +733,55 @@ class ClusterRuntime(Runtime):
             except Exception:
                 pass
 
+    def _fastpath_failed(self, entries: List[dict]) -> None:
+        """A leased worker died with these tasks outstanding: retry via the
+        raylet path (deps may have been lost with the node's worker — the
+        scheduler re-gates them) or surface the failure as a stored error
+        (reference: task_manager.h retry-on-worker-death budget)."""
+        for entry in entries:
+            entry.pop("_fast", None)
+            if entry.get("task_id") in self._cancelled_tids:
+                self._cancelled_tids.discard(entry["task_id"])
+                self._store_error_object(
+                    entry,
+                    exc.TaskCancelledError(
+                        f"{entry.get('desc','task')} was cancelled"
+                    ),
+                )
+                continue
+            mr = entry.get("max_retries", 0)
+            attempt = entry.get("attempt", 0)
+            if mr < 0 or attempt < mr:
+                entry = dict(entry)
+                entry["attempt"] = attempt + 1
+                rec = self._records.get((entry.get("return_ids") or [None])[0])
+                if rec is not None:
+                    rec.attempts = entry["attempt"]
+                    rec.last_submit = time.monotonic()
+                self._submit_entry_slow(entry)
+            else:
+                self._store_error_object(
+                    entry,
+                    exc.WorkerCrashedError(
+                        f"worker died executing {entry.get('desc','task')}"
+                    ),
+                )
+            self._fast_sealed(entry["return_ids"])
+
+    def _actor_fast_failed(self, actor_hex: str, entries: List[dict]) -> None:
+        """In-flight direct actor calls when the actor's worker died: fail
+        them like the raylet fails its in-flight list on actor death."""
+        err = RuntimeError(f"actor {actor_hex[:8]} died (worker process exited)")
+        for entry in entries:
+            self._store_error_object(entry, err)
+            self._fast_sealed(entry["return_ids"])
+
     def _submit_entry(self, entry: dict) -> None:
+        if not entry.get("pg_id") and self._fastpath.try_submit(entry):
+            return
+        self._submit_entry_slow(entry)
+
+    def _submit_entry_slow(self, entry: dict) -> None:
         if entry.get("pg_id"):
             target = self._gcs.call("pick_bundle", entry["pg_id"], entry["bundle_index"])
             if target is None:
@@ -714,15 +913,30 @@ class ClusterRuntime(Runtime):
         entry = _entry_from_spec(spec)
         spec.return_ids = [ObjectID.from_hex(h) for h in entry["return_ids"]]
         self._record_submission(entry, "actor_task")
+        self._actor_channel(spec.actor_id.hex()).submit(entry)
+        return spec.return_ids
+
+    def _actor_channel(self, actor_hex: str):
+        with self._actor_channels_lock:
+            ch = self._actor_channels.get(actor_hex)
+            if ch is None:
+                from .fastpath import ActorChannel
+
+                ch = ActorChannel(self, actor_hex)
+                self._actor_channels[actor_hex] = ch
+            return ch
+
+    def _submit_actor_slow(self, entry: dict) -> None:
+        """Raylet-mediated actor submission (remote nodes, fallback)."""
+        actor_id = ActorID.from_hex(entry["actor_id"])
         try:
-            self._actor_raylet(spec.actor_id).call("submit_actor_task", pickle.dumps(entry))
+            self._actor_raylet(actor_id).call("submit_actor_task", pickle.dumps(entry))
         except exc.ActorDiedError:
             raise
         except Exception:
             # Location may be stale (actor restarted elsewhere): refresh once.
-            self._actor_location.pop(spec.actor_id.hex(), None)
-            self._actor_raylet(spec.actor_id).call("submit_actor_task", pickle.dumps(entry))
-        return spec.return_ids
+            self._actor_location.pop(entry["actor_id"], None)
+            self._actor_raylet(actor_id).call("submit_actor_task", pickle.dumps(entry))
 
     def cancel(self, object_id: ObjectID, force: bool = False) -> None:
         """Cancels the task producing `object_id` (reference: worker.py
@@ -736,6 +950,18 @@ class ClusterRuntime(Runtime):
             )
         tid = rec.entry["task_id"]
         rec.entry["max_retries"] = 0  # a cancelled task must not be retried
+        if rec.entry.get("_fast"):
+            # Fast-path task: it lives on a leased worker this owner chose —
+            # no task-table lookup needed. The worker is interrupted and a
+            # force-kill surfaces as TaskCancelledError via the lease EOF.
+            self._cancelled_tids.add(tid)
+            try:
+                self._raylet.call(
+                    "cancel_lease_task", rec.entry["_fast"], tid, force
+                )
+            except Exception:
+                pass
+            return
         # Task events are batch-flushed (~0.2s): wait briefly for the
         # holding node to be known; if it stays unknown (early cancel of a
         # forwarded task), broadcast to every alive raylet.
@@ -816,6 +1042,14 @@ class ClusterRuntime(Runtime):
         self._shutdown_done = True
         self._free_wake.set()
         self._submit_wake.set()
+        try:
+            self._fastpath.close()
+            with self._actor_channels_lock:
+                channels = list(self._actor_channels.values())
+            for ch in channels:
+                ch.close()
+        except Exception:
+            pass
         if self._driver and self._procs:
             for node in self.nodes():
                 try:

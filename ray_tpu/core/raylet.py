@@ -49,6 +49,8 @@ class _Worker:
         self.actor_id: Optional[str] = None  # dedicated actor worker
         self.env_key = env_key  # runtime-env pool key (reference:
         # worker_pool.h PopWorker matching runtime_env_hash)
+        self.last_done: Optional[str] = None  # idempotency: a retried
+        # worker_step must not double-apply its completion report
 
 
 class RayletService:
@@ -90,6 +92,10 @@ class RayletService:
 
         self._workers: Dict[str, _Worker] = {}
         self._idle: Dict[str, List[str]] = {}  # env_key -> idle worker ids
+        # Leased workers: owner pushes tasks to the worker's direct socket;
+        # the raylet holds the lease's resources until it is returned
+        # (reference: HandleRequestWorkerLease, node_manager.cc:1797).
+        self._leases: Dict[str, Dict[str, Any]] = {}
         self._workers_lock = threading.Lock()
         self._max_task_workers = max(1, int(resources.get("CPU", 1)))
         # Task ids with cancel intent (reference: core_worker CancelTask ->
@@ -230,10 +236,20 @@ class RayletService:
         """Leases a PG bundle out of this node's free pool. The reservation
         survives heartbeats because it is debited from `available` here, at
         the source of truth."""
+        key = (pg_id, bundle_index)
         with self._res_lock:
-            key = (pg_id, bundle_index)
             if key in self._bundles:
                 return True  # idempotent retry
+            short = not all(
+                self.available.get(k, 0.0) >= v for k, v in resources.items()
+            )
+        if short:
+            # Leases may be sitting on the resources this bundle needs:
+            # reclaim (release is immediate) and re-check once.
+            self._maybe_reclaim_leases(resources)
+        with self._res_lock:
+            if key in self._bundles:
+                return True
             if not all(self.available.get(k, 0.0) >= v for k, v in resources.items()):
                 return False
             for k, v in resources.items():
@@ -428,14 +444,12 @@ class RayletService:
                 # scheduling_strategy="SPREAD"). Not gated on the cached
                 # cluster size: it lags a heartbeat behind node additions,
                 # and an explicit SPREAD request justifies the GCS hop.
-                try:
-                    target = self.gcs.call("pick_node", resources, [], "spread")
-                    if target is not None and target["node_id"] != self.node_id:
-                        return self._remote(target["sock"]).call(
-                            "submit_task", blob(), True
-                        )
-                except Exception:
-                    pass  # fall back to local/default placement
+                # Off the handler thread: a dead target would stall every
+                # subsequent submission pipelined on this connection.
+                threading.Thread(
+                    target=self._place_spread, args=(entry, blob()), daemon=True
+                ).start()
+                return entry["return_ids"]
             # Cluster-level decision: if it can't run here (ever, or not
             # soon) and another node has room now, forward it.
             if not self._fits_total(resources):
@@ -515,6 +529,21 @@ class RayletService:
         entry = dict(entry)
         entry["strategy"] = "DEFAULT"
         self._ingest_entry(entry, None, False)
+
+    def _place_spread(self, entry: dict, spec_blob: bytes) -> None:
+        """Resolves + forwards a SPREAD task (background thread); any
+        failure falls back to local default placement."""
+        try:
+            target = self.gcs.call("pick_node", entry["resources"], [], "spread")
+            if target is not None and target["node_id"] != self.node_id:
+                self._remote(target["sock"]).call("submit_task", spec_blob, True)
+                return
+        except Exception:
+            pass
+        entry["type"] = "task"
+        self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
+        self._pending.put(entry)
+        self._sched_wake.set()
 
     def _place_elsewhere(self, entry: dict, spec_blob: bytes) -> None:
         """Finds a node for a task this node can never run; retries while
@@ -1018,6 +1047,208 @@ class RayletService:
                     self._deferred_deletes.add(h)
         return freed
 
+    # --------------------------------------------------- leased fast path
+    def _direct_sock(self, worker_id: str) -> str:
+        """The worker's direct-push UDS (created by the worker at boot,
+        path derived identically on both sides)."""
+        return os.path.join(
+            os.path.dirname(self.sock_path) or ".", f"wkr_{worker_id}.sock"
+        )
+
+    def request_worker_lease(
+        self, resources: Dict[str, float], env_key: str = ""
+    ) -> dict:
+        """Grants a worker lease for direct owner->worker task pushes: the
+        resources are held for the lease lifetime and the raylet steps out
+        of the per-task loop entirely (reference:
+        normal_task_submitter.cc:354 RequestWorkerLease + the cached lease
+        reuse at :555)."""
+        resources = dict(resources or {"CPU": 1.0})
+        if not self._fits_total(resources):
+            try:
+                target = self.gcs.call("pick_node", resources, [self.node_id])
+            except Exception:
+                target = None
+            if target is not None and target["node_id"] != self.node_id:
+                return {"spill": target["sock"]}
+            return {"retry": True}
+        if (self._waiting or self._pending.qsize()) and not self._can_run_soon(
+            {k: 2 * v for k, v in resources.items()}
+        ):
+            # Queued work exists and granting would take the last capacity:
+            # let the queue drain first — a lease stealing it would be
+            # revoked milliseconds later anyway (grant/revoke churn).
+            return {"retry": True}
+        if not self._try_acquire(resources):
+            if self._cluster_size > 1:
+                try:
+                    target = self.gcs.call("pick_node", resources, [self.node_id])
+                except Exception:
+                    target = None
+                if target is not None and target["node_id"] != self.node_id:
+                    return {"spill": target["sock"]}
+            return {"retry": True}
+        w = self._checkout_worker(env_key)
+        if w is None:
+            self._release(resources)
+            return {"retry": True}
+        self._leases[w.worker_id] = {
+            "resources": resources,
+            "granted_at": time.monotonic(),
+        }
+        w.mailbox.put({"type": "direct"})
+        return {
+            "granted": {
+                "worker_id": w.worker_id,
+                "sock": self._direct_sock(w.worker_id),
+            }
+        }
+
+    def return_worker_lease(self, worker_id: str) -> bool:
+        """Lease handed back (worker-initiated, after the owner's direct
+        socket closed): release the held resources and pool the worker."""
+        lease = self._leases.pop(worker_id, None)
+        if lease is not None:
+            self._release(lease["resources"])
+        if os.environ.get("RAY_TPU_DEBUG_DIRECT") == "1":
+            print(f"[raylet] lease returned by {worker_id[:6]}", file=sys.stderr, flush=True)
+        with self._workers_lock:
+            w = self._workers.get(worker_id)
+            if (
+                w is not None
+                and w.proc.poll() is None
+                and w.actor_id is None
+                and w.busy_with is None
+            ):
+                idle = self._idle.setdefault(w.env_key, [])
+                if worker_id not in idle:
+                    idle.append(worker_id)
+        self._sched_wake.set()
+        return True
+
+    def _maybe_reclaim_leases(self, needed: Dict[str, float]) -> None:
+        """Queued work cannot acquire resources while leases hold them:
+        revoke leases (resources released NOW — bookkeeping oversubscribes
+        briefly while the lease drains) and tell each worker to wind down.
+        The worker relays a revoke frame to its owner, which drains
+        outstanding pushes and closes; the worker then rejoins the pool
+        (reference: the raylet-requested lease return in
+        normal_task_submitter ReturnWorker/lease cancellation)."""
+        now = time.monotonic()
+        if now - getattr(self, "_last_reclaim", 0.0) < 0.1:
+            return
+        self._last_reclaim = now
+        if os.environ.get("RAY_TPU_DEBUG_DIRECT") == "1":
+            print(f"[raylet] reclaim check: leases={list(self._leases)}", file=sys.stderr, flush=True)
+        victims: List[str] = []
+        for wid, lease in list(self._leases.items()):
+            if now - lease.get("granted_at", 0.0) < 0.25:
+                continue  # just granted; let it do some work first
+            if any(lease["resources"].get(k, 0.0) > 0 for k in needed) or not needed:
+                victims.append(wid)
+                lease2 = self._leases.pop(wid, None)
+                if lease2 is not None:
+                    self._release(lease2["resources"])
+        for wid in victims:
+            threading.Thread(
+                target=self._send_revoke, args=(wid,), daemon=True
+            ).start()
+        if victims:
+            self._sched_wake.set()
+
+    def _send_revoke(self, worker_id: str) -> None:
+        """Tells a worker (over its direct socket) that its lease is
+        revoked. Retries while the worker boots — a freshly-spawned leased
+        worker takes ~1-2s to bind its direct socket, and a revoke racing
+        that bind must not be lost (the lease resources are already
+        released; an unrevoked worker would idle in direct mode forever)."""
+        import socket as socketlib
+
+        from .rpc import _send_msg
+
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            with self._workers_lock:
+                w = self._workers.get(worker_id)
+            if w is None or w.proc.poll() is not None:
+                return  # dead: the monitor reaps it
+            try:
+                s = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+                s.settimeout(2.0)
+                s.connect(self._direct_sock(worker_id))
+                _send_msg(s, pickle.dumps(("rv",)))
+                s.close()
+                return
+            except OSError:
+                time.sleep(0.1)
+
+    def lease_active(self, worker_id: str) -> bool:
+        return worker_id in self._leases
+
+    def cancel_lease_task(self, worker_id: str, task_id: str, force: bool = False) -> bool:
+        """Cancels a task the owner pushed directly to a leased worker.
+        The raylet does not know the worker's queue, so it marks intent
+        (the worker checks is_cancelled) and interrupts the process — the
+        same signal protocol as the mailbox path."""
+        self._mark_cancelled(task_id)
+        with self._workers_lock:
+            w = self._workers.get(worker_id)
+        if w is None:
+            return False
+        if force:
+            w.proc.kill()
+        else:
+            try:
+                w.proc.send_signal(signal.SIGINT)
+            except OSError:
+                pass
+        return True
+
+    def fastpath_done(self, worker_id: str, sealed: List[str], events) -> bool:
+        """Batched completion notifications from a leased/direct worker:
+        seal locations for the GCS directory + waiters, task events for
+        the state API. One-way and coalesced — never on the latency path."""
+        if sealed:
+            self._notify_sealed(sealed)
+        for tid, state in events or ():
+            self._task_event(tid, state)
+        return True
+
+    def actor_direct_sock(self, actor_id: str) -> Optional[str]:
+        """The direct-push socket of the worker hosting this actor (None
+        until the actor is ALIVE here)."""
+        with self._actor_lock:
+            a = self._actors.get(actor_id)
+            if not a or a.get("state") != "ALIVE" or not a.get("worker_id"):
+                return None
+            wid = a["worker_id"]
+        return self._direct_sock(wid)
+
+    def debug_state(self) -> dict:
+        """Scheduler/worker-pool introspection (ray-tpu status --verbose;
+        reference: the raylet's DebugString dumped to raylet.out)."""
+        with self._workers_lock:
+            workers = {
+                wid: {
+                    "actor": w.actor_id,
+                    "busy": (w.busy_with or {}).get("task_id"),
+                    "env_key": w.env_key,
+                    "alive": w.proc.poll() is None,
+                }
+                for wid, w in self._workers.items()
+            }
+            idle = {k: list(v) for k, v in self._idle.items()}
+        with self._res_lock:
+            avail = dict(self.available)
+        return {
+            "workers": workers,
+            "idle": idle,
+            "leases": {k: v["resources"] for k, v in self._leases.items()},
+            "available": avail,
+            "waiting": [e.get("task_id") for e in self._waiting],
+            "pending_qsize": self._pending.qsize(),
+        }
+
     # ----------------------------------------------------- worker service
     def worker_poll(self, worker_id: str) -> dict:
         """Long-poll: the worker's task mailbox (reference: the PushTask
@@ -1027,6 +1258,13 @@ class RayletService:
             w = self._workers.get(worker_id)
         if w is None:
             return {"type": "stop"}
+        if w.busy_with is not None and w.mailbox.empty():
+            # A serial worker only polls after completing its current task,
+            # and its completion report is processed before this poll — so
+            # a poll arriving with busy_with still set means the reply that
+            # carried this entry was lost (client reconnect+resend):
+            # re-deliver instead of wedging the task forever.
+            return {"type": "task", "entry": w.busy_with}
         try:
             return w.mailbox.get(timeout=POLL_TIMEOUT_S)
         except queue.Empty:
@@ -1053,6 +1291,15 @@ class RayletService:
         sealed: Optional[List[str]] = None,
         task_id: Optional[str] = None,
     ) -> bool:
+        with self._workers_lock:
+            w0 = self._workers.get(worker_id)
+            if w0 is not None and task_id is not None and w0.last_done == task_id:
+                # Duplicate report (RPC client reconnect re-sent the step):
+                # task ids are unique, so matching last_done alone is
+                # sufficient — and requiring busy_with None here would let
+                # a dup clobber a NEWLY assigned task (mark it finished
+                # without ever executing it).
+                return True
         if sealed:
             # The task's return objects: wake local waiters + batch the
             # directory update (folded into this RPC so completion costs one
@@ -1066,8 +1313,11 @@ class RayletService:
                 return False
             entry = w.busy_with
             w.busy_with = None
+            w.last_done = task_id
             if w.actor_id is None:
-                self._idle.setdefault(w.env_key, []).append(worker_id)
+                idle = self._idle.setdefault(w.env_key, [])
+                if worker_id not in idle:
+                    idle.append(worker_id)
         if w.actor_id is not None and entry is None:
             # Actor task completion: remove the matching in-flight entry
             # (by task id — concurrent actors complete out of order).
@@ -1178,6 +1428,7 @@ class RayletService:
             if self._fail_if_unschedulable(entry):
                 return True
             if not self._try_acquire_entry(entry):
+                self._maybe_reclaim_leases(entry["resources"])
                 return False
             w = self._checkout_worker(self._env_key(entry))
             if w is None:
@@ -1198,6 +1449,7 @@ class RayletService:
                 )
                 return True
             if not self._try_acquire_entry(entry):
+                self._maybe_reclaim_leases(entry["resources"])
                 return False
             w = self._spawn_worker(
                 actor_id=entry["actor_id"],
@@ -1377,6 +1629,15 @@ class RayletService:
                         if idle_list and w.worker_id in idle_list:
                             idle_list.remove(w.worker_id)
             for w in dead:
+                lease = self._leases.pop(w.worker_id, None)
+                if lease is not None:
+                    # Leased worker died: hand back the lease's resources;
+                    # the owner's direct socket EOF drives task retries.
+                    self._release(lease["resources"])
+                try:
+                    os.unlink(self._direct_sock(w.worker_id))
+                except OSError:
+                    pass
                 entry = w.busy_with
                 if entry is not None:
                     if entry["type"] == "task":

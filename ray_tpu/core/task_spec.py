@@ -96,6 +96,10 @@ class FunctionTable:
             hit = self._cache.get(func_hash)
         if hit is not None:
             return hit
+        if blob is None:
+            # Fast-path frames ship the blob once per connection; a miss
+            # here means the sender's cache view diverged from ours.
+            raise RuntimeError(f"function blob missing for hash {func_hash[:12]}")
         fn = cloudpickle.loads(blob)
         with self._lock:
             self._cache[func_hash] = fn

@@ -102,11 +102,16 @@ class _AsyncLoop:
 def main(argv: List[str]) -> None:
     raylet_sock, store_path, gcs_sock, worker_id, node_id = argv
 
+    import pickle
+    import queue
+    import socket as socketlib
+    import time
+
     from .. import exceptions as exc
-    from . import runtime_base
+    from . import runtime_base, serialization
     from .cluster_runtime import ClusterRuntime
     from .object_transport import StoredError
-    from .rpc import RpcClient
+    from .rpc import RpcClient, _recv_msg, _send_msg
     from .shm_store import SharedMemoryStore
 
     runtime_env = json.loads(os.environ.get("RAY_TPU_RUNTIME_ENV", "{}") or "{}")
@@ -139,6 +144,8 @@ def main(argv: List[str]) -> None:
 
     signal.signal(signal.SIGINT, _sigint)
 
+    INLINE_MAX = 64 * 1024  # results below this ride the completion ack
+
     def store_returns(entry: dict, result: Any, sealed: List[str]) -> None:
         rids = [ObjectID.from_hex(h) for h in entry["return_ids"]]
         if len(rids) == 1:
@@ -149,7 +156,27 @@ def main(argv: List[str]) -> None:
                 raise ValueError(
                     f"task returned {len(values)} values, expected {len(rids)}"
                 )
+        inline = entry.get("_inline")
         for rid, v in zip(rids, values):
+            if inline is not None:
+                # Direct task: small results return in-band to the owner's
+                # memory store — no shm write, no seal/location/free churn
+                # (reference: small returns inline in PushTaskReply,
+                # task_manager.cc HandleTaskReturn in-memory store path).
+                try:
+                    blob = serialization.pack(v)
+                except Exception:
+                    blob = None
+                if blob is not None and len(blob) <= INLINE_MAX:
+                    inline[rid.hex()] = blob
+                    continue
+                if blob is not None:
+                    try:
+                        store.put_raw(rid, blob)
+                        sealed.append(rid.hex())
+                        continue
+                    except exc.ObjectStoreFullError:
+                        pass  # fall through to the pressure-aware path
             store.put_with_pressure(
                 rid, v, raylet, pre_pressure=runtime.flush_local_frees
             )
@@ -158,6 +185,16 @@ def main(argv: List[str]) -> None:
     def store_error(entry: dict, err: BaseException, sealed: List[str]) -> None:
         if not isinstance(err, exc.RayTpuError):
             err = exc.TaskError(err, task_desc=entry.get("desc", ""))
+        inline = entry.get("_inline")
+        if inline is not None:
+            try:
+                blob = serialization.pack(StoredError(err, entry.get("desc", "")))
+                if len(blob) <= INLINE_MAX:
+                    for h in entry["return_ids"]:
+                        inline[h] = blob
+                    return
+            except Exception:
+                pass
         for h in entry["return_ids"]:
             rid = ObjectID.from_hex(h)
             try:
@@ -224,6 +261,53 @@ def main(argv: List[str]) -> None:
     def done(entry: dict, ok: bool, sealed: List[str]) -> None:
         raylet.notify("worker_done", worker_id, ok, sealed, entry.get("task_id"))
 
+    # ----- direct (leased / fast-path) service ----------------------------
+    # Every worker serves a UDS next to the raylet socket; owners holding a
+    # lease (or an actor handle) push task frames here directly, skipping
+    # the raylet on the hot path (reference: CoreWorker's PushTask server,
+    # core_worker.cc HandlePushTask). Completion acks ride the same socket;
+    # seal locations + task events flow to the raylet in coalesced one-way
+    # batches so the GCS directory and waiters still learn of results.
+    direct_sock_path = os.path.join(
+        os.path.dirname(raylet_sock) or ".", f"wkr_{worker_id}.sock"
+    )
+    direct_inbox: "queue.Queue" = queue.Queue()
+    direct_conns: set = set()
+    accept_count = [0]
+    exec_lock = threading.Lock()  # serializes serial-lane execution across
+    # the main loop and direct connection threads (an actor with
+    # max_concurrency=1 must never run two methods at once).
+    notify_q: "queue.Queue" = queue.Queue()
+
+    def _notify_loop() -> None:
+        cli = RpcClient(raylet_sock)
+        while True:
+            first = notify_q.get()
+            time.sleep(0.001)  # coalesce a burst into one raylet message
+            batch = [first]
+            while True:
+                try:
+                    batch.append(notify_q.get_nowait())
+                except queue.Empty:
+                    break
+            sealed = [h for s, _ in batch for h in s]
+            events = [e for _, e in batch if e is not None]
+            try:
+                cli.notify("fastpath_done", worker_id, sealed, events)
+            except Exception:
+                return  # raylet gone; the worker is about to die anyway
+
+    threading.Thread(target=_notify_loop, daemon=True, name="fp-notify").start()
+
+    def fp_report(sealed: List[str], event) -> None:
+        notify_q.put((sealed, event))
+
+    _dbg = os.environ.get("RAY_TPU_DEBUG_DIRECT") == "1"
+
+    def _dlog(msg: str) -> None:
+        if _dbg:
+            print(f"[direct {worker_id[:6]}] {msg}", file=sys.stderr, flush=True)
+
     # ----- concurrent actor executors -------------------------------------
     pool: Optional[Any] = None  # ThreadPoolExecutor for threaded actors
     aio: Optional[_AsyncLoop] = None
@@ -263,8 +347,10 @@ def main(argv: List[str]) -> None:
             store_error(entry, e, sealed)
             return False
 
-    def exec_actor_task_async(entry: dict) -> None:
+    def exec_actor_task_async(entry: dict, report=None) -> None:
         """Runs an async actor method on the event loop."""
+        if report is None:
+            report = done
         inst = actor_instance.get(entry["actor_id"])
 
         async def coro():
@@ -290,14 +376,14 @@ def main(argv: List[str]) -> None:
             try:
                 result = fut.result()
                 store_returns(entry, result, sealed)
-                done(entry, True, sealed)
+                report(entry, True, sealed)
             except SystemExit:
                 store_returns(entry, None, sealed)
-                done(entry, True, sealed)
+                report(entry, True, sealed)
                 os._exit(0)
             except BaseException as e:  # noqa: BLE001
                 store_error(entry, e, sealed)
-                done(entry, False, sealed)
+                report(entry, False, sealed)
 
         def on_done(fut):
             # Completion does shm writes + a raylet RPC: run it OFF the
@@ -306,18 +392,265 @@ def main(argv: List[str]) -> None:
 
         aio.submit(coro, on_done)
 
-    def exec_threaded(entry: dict) -> None:
+    def exec_threaded(entry: dict, report=None) -> None:
+        if report is None:
+            report = done
         def run():
             sealed: List[str] = []
             try:
                 ok = run_body(entry, sealed)
             except SystemExit:
-                done(entry, True, sealed)
+                report(entry, True, sealed)
                 os._exit(0)
                 return
-            done(entry, ok, sealed)
+            report(entry, ok, sealed)
 
         pool.submit(run)
+
+    # ----- direct server --------------------------------------------------
+    def _exec_direct_actor(entry: dict, send_done) -> None:
+        """An actor call arriving on the direct socket. Serial actors run
+        inline on the connection thread (strict per-connection FIFO, which
+        IS the per-caller order); concurrent actors dispatch to their pool
+        or event loop exactly like the raylet path."""
+
+        def report(e: dict, ok: bool, sealed: List[str]) -> None:
+            send_done(e["task_id"], ok, sealed, e.get("_inline"))
+            fp_report(sealed, (e["task_id"], "FINISHED" if ok else "FAILED"))
+
+        if aio is not None:
+            exec_actor_task_async(entry, report)
+            return
+        if pool is not None:
+            exec_threaded(entry, report)
+            return
+        sealed: List[str] = []
+        with exec_lock:
+            ok = run_body(entry, sealed)
+        report(entry, ok, sealed)
+
+    conn_senders: Dict[Any, Any] = {}
+    lease_revoked = [False]  # sticky until the lease is returned: a revoke
+    # can land before the owner's connect (worker-boot race) and must
+    # still reach that owner when it arrives
+
+    def _conn_loop(conn) -> None:
+        wlock = threading.Lock()
+
+        def send_raw(frame: tuple) -> None:
+            with wlock:
+                _send_msg(conn, pickle.dumps(frame))
+
+        conn_senders[conn] = send_raw
+        if lease_revoked[0]:
+            try:
+                send_raw(("r",))
+            except OSError:
+                pass
+
+        def send_done(tid: str, ok: bool, sealed: List[str], inline=None) -> None:
+            try:
+                send_raw(("d", tid, ok, sealed, inline or None))
+            except OSError:
+                pass  # owner gone; results are sealed regardless
+
+        try:
+            while True:
+                try:
+                    frame = pickle.loads(_recv_msg(conn))
+                except (ConnectionError, OSError, EOFError):
+                    _dlog("conn EOF")
+                    break
+                kind = frame[0]
+                if _dbg and kind != "t":
+                    _dlog(f"frame {kind!r}")
+                if kind == "t":
+                    # Leased normal task: the main thread executes it (keeps
+                    # SIGINT cancellation + serial semantics).
+                    _, tid, fh, fb, ab, rids, desc = frame
+                    entry = {
+                        "type": "task",
+                        "task_id": tid,
+                        "func_hash": fh,
+                        "func_blob": fb,
+                        "args_blob": ab,
+                        "return_ids": rids,
+                        "desc": desc,
+                        "_inline": {},
+                    }
+                    direct_inbox.put((entry, send_done))
+                elif kind == "a":
+                    _, tid, aid, method, ab, rids, desc = frame
+                    entry = {
+                        "type": "actor_task",
+                        "task_id": tid,
+                        "actor_id": aid,
+                        "method_name": method,
+                        "args_blob": ab,
+                        "return_ids": rids,
+                        "desc": desc,
+                        "_inline": {},
+                    }
+                    _exec_direct_actor(entry, send_done)
+                elif kind == "rv":
+                    _dlog(f"revoke received; relaying to {len(conn_senders)} conns")
+                    lease_revoked[0] = True
+                    # Raylet revoked this worker's lease: relay a drain
+                    # request to every connected owner; they stop pushing,
+                    # outstanding work completes, sockets close, and the
+                    # main loop hands the worker back to the pool.
+                    for sender in list(conn_senders.values()):
+                        try:
+                            sender(("r",))
+                        except OSError:
+                            pass
+                elif kind == "p":
+                    send_raw(("p",))
+        finally:
+            conn_senders.pop(conn, None)
+            direct_conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_direct() -> None:
+        try:
+            srv = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+            try:
+                os.unlink(direct_sock_path)
+            except OSError:
+                pass
+            srv.bind(direct_sock_path)
+            srv.listen(128)
+        except BaseException as e:  # noqa: BLE001
+            _dlog(f"direct server failed to bind: {e!r}")
+            raise
+        _dlog(f"direct server listening at {direct_sock_path}")
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError as e:
+                _dlog(f"accept failed: {e!r}")
+                return
+            direct_conns.add(conn)
+            accept_count[0] += 1
+            _dlog(f"accepted conn #{accept_count[0]}")
+            threading.Thread(
+                target=_conn_loop, args=(conn,), daemon=True, name="direct-conn"
+            ).start()
+
+    threading.Thread(target=_serve_direct, daemon=True, name="direct-srv").start()
+
+    def _run_direct_mode() -> None:
+        """Lease mode: drain direct-pushed tasks on the main thread until
+        the lease owner disconnects, then hand the worker back to the
+        raylet pool (reference: the leased worker returning to the raylet
+        after lease_expiration, normal_task_submitter.cc ReturnWorker)."""
+        entered = time.monotonic()
+        epoch_accepts = accept_count[0]
+        last_lease_check = time.monotonic()
+        cancel_scan = False  # an interrupt arrived for a task further down
+        # the queue: verify each task against the raylet until it is found
+        _dlog("enter direct mode")
+        while True:
+            try:
+                entry, send_done = direct_inbox.get(timeout=0.25)
+            except queue.Empty:
+                if not direct_conns and (
+                    # a conn came and went (accept counter moved — conns
+                    # can live shorter than this poll period), or the
+                    # lease is known-revoked, or nobody ever showed up
+                    accept_count[0] > epoch_accepts
+                    or lease_revoked[0]
+                    or time.monotonic() - entered > 10.0
+                ):
+                    # Final drain: pushes that raced the revoke/close are
+                    # still valid work — execute them before handing back
+                    # (their acks are the owner's only completion signal).
+                    while True:
+                        try:
+                            entry, send_done = direct_inbox.get_nowait()
+                        except queue.Empty:
+                            break
+                        sealed: List[str] = []
+                        ok = run_body(entry, sealed)
+                        send_done(entry["task_id"], ok, sealed, entry.get("_inline"))
+                        fp_report(
+                            sealed,
+                            (entry["task_id"], "FINISHED" if ok else "FAILED"),
+                        )
+                    _dlog("exit direct mode")
+                    lease_revoked[0] = False  # next lease: fresh epoch
+                    return
+                now = time.monotonic()
+                if now - last_lease_check > 5.0:
+                    # Belt for a lost revoke: if the raylet no longer holds
+                    # our lease, drain the owners and hand ourselves back.
+                    last_lease_check = now
+                    try:
+                        if not raylet.call("lease_active", worker_id, timeout=5.0):
+                            _dlog("lease gone; draining owners")
+                            lease_revoked[0] = True
+                            for sender in list(conn_senders.values()):
+                                try:
+                                    sender(("r",))
+                                except OSError:
+                                    pass
+                    except Exception:
+                        pass
+                continue
+            _dlog(f"exec {entry.get('task_id','?')[:8]}")
+            sealed: List[str] = []
+            ok = False
+            executing_main.set()
+            try:
+                if pending_interrupt.is_set() or cancel_scan:
+                    pending_interrupt.clear()
+                    if raylet.call("is_cancelled", entry["task_id"]):
+                        cancel_scan = False
+                        raise KeyboardInterrupt
+                with exec_lock:
+                    ok = run_body(entry, sealed)
+            except KeyboardInterrupt:
+                # The SIGINT cancel protocol names no task: confirm THIS
+                # task was the target; if not, the victim is retried and
+                # later tasks are scanned until the real target surfaces.
+                try:
+                    was_target = raylet.call("is_cancelled", entry["task_id"])
+                except Exception:
+                    was_target = True
+                if was_target or sealed:
+                    store_error(
+                        entry,
+                        exc.TaskCancelledError(
+                            f"{entry.get('desc','task')} was cancelled"
+                        ),
+                        sealed,
+                    )
+                else:
+                    cancel_scan = True
+                    try:
+                        with exec_lock:
+                            ok = run_body(entry, sealed)
+                    except KeyboardInterrupt:
+                        store_error(
+                            entry,
+                            exc.TaskCancelledError(
+                                f"{entry.get('desc','task')} was cancelled"
+                            ),
+                            sealed,
+                        )
+            except SystemExit:
+                executing_main.clear()
+                send_done(entry["task_id"], True, sealed, entry.get("_inline"))
+                fp_report(sealed, (entry["task_id"], "FINISHED"))
+                raylet.notify("return_worker_lease", worker_id)
+                os._exit(0)
+            finally:
+                executing_main.clear()
+            send_done(entry["task_id"], ok, sealed, entry.get("_inline"))
+            fp_report(sealed, (entry["task_id"], "FINISHED" if ok else "FAILED"))
 
     # Serial-path completions piggyback on the next poll (worker_step):
     # one RPC per task instead of done-notify + poll. Threaded/async actor
@@ -332,6 +665,13 @@ def main(argv: List[str]) -> None:
         kind = msg.get("type")
         if kind == "stop":
             return
+        if kind == "direct" or (kind == "noop" and not direct_inbox.empty()):
+            # Leased to an owner for direct pushes (the inbox check is the
+            # belt for a lost control message: direct frames queued while
+            # we idled in worker_step still get served).
+            _run_direct_mode()
+            raylet.notify("return_worker_lease", worker_id)
+            continue
         if kind == "noop":
             continue
         if kind == "task":
@@ -363,7 +703,8 @@ def main(argv: List[str]) -> None:
                     pending_interrupt.clear()
                     if raylet.call("is_cancelled", entry["task_id"]):
                         raise KeyboardInterrupt
-                ok = run_body(entry, sealed)
+                with exec_lock:
+                    ok = run_body(entry, sealed)
             except KeyboardInterrupt:
                 store_error(
                     entry,

@@ -642,6 +642,14 @@ def _stable_hash(v: Any) -> int:
     partition — the reduce side groups by Python equality)."""
     import hashlib
 
+    if hasattr(v, "item") and not isinstance(v, (bytes, str)):
+        # Numpy scalars repr differently from equal Python scalars
+        # ('np.int64(3)' vs '3' under numpy>=2): canonicalize first or
+        # map-side partitions disagree with reduce-side Python equality.
+        try:
+            v = v.item()
+        except Exception:
+            pass
     if isinstance(v, (bool, int, float)) and not isinstance(v, float):
         try:
             if float(v) == v:

@@ -164,9 +164,32 @@ def apply_rope(x, cos, sin):
 def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
     if cfg.attn_impl == "full":
         # Fused pallas kernel (handles GQA internally; falls back to the
-        # unfused path for untileable shapes).
+        # unfused path for untileable shapes). With a tensor axis in the
+        # mesh, the kernel runs under shard_map with HEADS sharded over
+        # "tensor" — attention is embarrassingly parallel across heads, so
+        # TP attention is N independent per-shard kernels, no collectives
+        # (reference: net-new; Ray delegates TP to user code, SURVEY §2h).
         from ..ops.flash_attention import flash_attention
 
+        if (
+            mesh is not None
+            and "tensor" in mesh.axis_names
+            and mesh.shape["tensor"] > 1
+            and q.shape[2] % mesh.shape["tensor"] == 0
+            and k.shape[2] % mesh.shape["tensor"] == 0
+        ):
+            from jax.sharding import PartitionSpec as _P
+
+            from ..parallel.collectives import shard_map as _smap
+
+            batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+            spec = _P(batch_axes if batch_axes else None, None, "tensor", None)
+            return _smap(
+                lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=True),
+                mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+            )(q, k, v)
         return flash_attention(q, k, v, causal=True)
     if cfg.n_kv_heads != cfg.n_heads:
         rep = cfg.n_heads // cfg.n_kv_heads
@@ -268,7 +291,12 @@ def next_token_loss(
 
 
 def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
-    """Approximate training FLOPs/token (6N + attention) for MFU accounting."""
+    """Approximate training FLOPs/token (6N + attention) for MFU accounting.
+
+    Attention is counted CAUSALLY (seq/2 average visible positions): the
+    flash kernel skips fully-masked blocks, so charging full s^2 would
+    inflate MFU by the skipped half. Per token per layer: QK^T + PV =
+    2 matmuls x 2 MAC-FLOPs x (seq/2) x d_model forward, x3 for fwd+bwd."""
     n_params = (
         cfg.vocab_size * cfg.d_model
         + cfg.n_layers
@@ -279,5 +307,5 @@ def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
         )
         + (0 if cfg.tie_embeddings else cfg.d_model * cfg.vocab_size)
     )
-    attn = 12 * cfg.n_layers * cfg.d_model * seq_len
+    attn = 12 * cfg.n_layers * cfg.d_model * (seq_len / 2)
     return 6.0 * n_params + attn

@@ -260,12 +260,18 @@ def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret, heads):
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, heads, res, do):
     q, k, v, o, lse = res
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # [bh, s, 1]
+    return _flash_bwd_impl(
+        causal, scale, block_q, block_k, interpret, heads, q, k, v, o, lse, do, delta
+    )
+
+
+def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, heads, q, k, v, o, lse, do, delta):
     h, h_kv = heads
     rep = h // h_kv
     bh, s, d = q.shape
     bh_kv = k.shape[0]
     nq, nk = s // block_q, s // block_k
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # [bh, s, 1]
     kv_map = _kv_index(h, h_kv)
 
     dq = pl.pallas_call(
@@ -327,6 +333,94 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, heads, res, do):
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret, heads):
+    """Flash attention that also RETURNS the per-row logsumexp — the
+    primitive ring attention composes across K/V blocks (partial outputs
+    merge by lse weighting). Gradient flows through BOTH outputs: an
+    upstream dlse folds into the delta term (ds = p*(dp - delta + dlse)),
+    so the same backward kernels serve."""
+    return _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret, heads)
+
+
+def _flash_lse_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret, heads):
+    o, lse = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret, heads)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_vjp_bwd(causal, scale, block_q, block_k, interpret, heads, res, g):
+    q, k, v, o, lse = res
+    do, dlse = g
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+    return _flash_bwd_impl(
+        causal, scale, block_q, block_k, interpret, heads, q, k, v, o, lse, do, delta
+    )
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+def reference_attention_with_lse(q, k, v, *, causal: bool, scale: float):
+    """Unfused differentiable (o, lse) pair for shapes the kernel cannot
+    tile (tiny CPU-test shards). lse: [b, h, s_q]."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        k_pos = lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.maximum(jnp.sum(p, axis=-1), 1e-30)
+    lse = m + jnp.log(l)
+    o = jnp.einsum(
+        "bhqk,bkhd->bqhd", (p / l[..., None]).astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return o.astype(q.dtype), lse
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+):
+    """Fused attention over [b, s, h, d] returning (out, lse[b, h, s]) —
+    the building block for ring attention's cross-shard online softmax."""
+    b, s, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"n_heads {h} not divisible by n_kv_heads {h_kv}")
+    scale = scale if scale is not None else d**-0.5
+    if interpret is None:
+        interpret = _auto_interpret()
+    bq, bk = _pick_block(s, block_q), _pick_block(s, block_k)
+    if pltpu is None or bq is None or bk is None:
+        if h_kv != h:
+            k = jnp.repeat(k, h // h_kv, axis=2)
+            v = jnp.repeat(v, h // h_kv, axis=2)
+        return reference_attention_with_lse(q, k, v, causal=causal, scale=scale)
+
+    def to_bh(x):
+        hh = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * hh, s, d)
+
+    o, lse = _flash_lse(
+        to_bh(q), to_bh(k), to_bh(v), causal, scale, bq, bk, interpret, (h, h_kv)
+    )
+    return (
+        o.reshape(b, h, s, d).transpose(0, 2, 1, 3),
+        lse.reshape(b, h, s),
+    )
 
 
 def flash_attention(

@@ -26,22 +26,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec
 
+from ..ops.flash_attention import flash_attention_with_lse
 from .collectives import shard_map
 
 NEG_INF = -1e30
-
-
-def _block_attn(q, k, v, bias, scale):
-    """One (q_block, kv_block) attention tile: returns (unnorm_out, row_max,
-    row_sumexp) for online-softmax accumulation. Contraction in fp32."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
-    if bias is not None:
-        s = s + bias
-    m = jnp.max(s, axis=-1)  # [b,h,q]
-    p = jnp.exp(s - m[..., None])
-    l = jnp.sum(p, axis=-1)  # [b,h,q]
-    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v, preferred_element_type=jnp.float32)
-    return o, m, l
 
 
 def _causal_bias(q_len, k_len, q_offset, k_offset, dtype=jnp.float32):
@@ -54,20 +42,30 @@ def _ring_attention_shard(q, k, v, *, axis: str, causal: bool, scale: float):
     """Per-device body (runs under shard_map). q/k/v: [b, s_shard, h, d].
 
     Rotation happens BEFORE compute for steps i>0, so the final hop is never
-    issued (n-1 transfers for n blocks). Under causal masking, blocks that
-    are entirely in the future (k_offset > last q position) are skipped with
-    `lax.cond` — on average half the blocks — matching the FLOP profile of
-    striped/causal ring attention.
+    issued (n-1 transfers for n blocks). Every (q_shard, kv_block) tile runs
+    the pallas flash kernel (ops/flash_attention) — the per-shard score
+    matrix never materializes in HBM, which is the whole point at 32k+
+    context. Because shards are equal-sized, a block is either fully
+    visible (src < rank: plain non-causal flash), the diagonal (src ==
+    rank: causal flash), or fully in the future (skipped): the kernel never
+    needs global-offset masks. Partial outputs merge by logsumexp weighting
+    (the flash kernel returns lse; gradient flows through it via
+    _flash_lse's custom VJP).
     """
     n = lax.axis_size(axis)
     rank = lax.axis_index(axis)
-    s_shard = q.shape[1]
-    q_offset = rank * s_shard
     # Receive from rank+1 side: after i rotations we hold block (rank+i)%n.
     perm = [(j, (j - 1) % n) for j in range(n)]
+    b, s, h, d = q.shape
+
+    def flash_block(k_cur, v_cur, blk_causal):
+        o_i, lse_i = flash_attention_with_lse(
+            q, k_cur, v_cur, causal=blk_causal, scale=scale
+        )
+        return o_i.astype(jnp.float32), lse_i
 
     def step(carry, i):
-        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        o_acc, lse_acc, k_cur, v_cur = carry
         k_cur, v_cur = lax.cond(
             i > 0,
             lambda kv: (lax.ppermute(kv[0], axis, perm), lax.ppermute(kv[1], axis, perm)),
@@ -75,43 +73,37 @@ def _ring_attention_shard(q, k, v, *, axis: str, causal: bool, scale: float):
             (k_cur, v_cur),
         )
         src = (rank + i) % n
-        k_offset = src * s_shard
-
-        def attend(o_acc, m_acc, l_acc):
-            bias = _causal_bias(s_shard, s_shard, q_offset, k_offset) if causal else None
-            o_i, m_i, l_i = _block_attn(q, k_cur, v_cur, bias, scale)
-            m_new = jnp.maximum(m_acc, m_i)
-            alpha = jnp.exp(m_acc - m_new)
-            beta = jnp.exp(m_i - m_new)
-            l_new = l_acc * alpha + l_i * beta
-            o_new = o_acc * alpha[..., None].transpose(0, 2, 1, 3) + o_i * beta[
-                ..., None
-            ].transpose(0, 2, 1, 3)
-            return o_new, m_new, l_new
 
         if causal:
-            # Fully-future block: every (q, k) pair masked; skip the matmuls.
-            fully_masked = k_offset > q_offset + s_shard - 1
-            o_acc, m_acc, l_acc = lax.cond(
-                fully_masked,
-                lambda o, m, l: (o, m, l),
-                attend,
-                o_acc,
-                m_acc,
-                l_acc,
+            # 0: fully-future (skip); 1: diagonal (causal); 2: past (full).
+            case = jnp.where(src == rank, 1, jnp.where(src < rank, 2, 0))
+            o_i, lse_i = lax.switch(
+                case,
+                [
+                    lambda kv: (
+                        jnp.zeros((b, s, h, d), jnp.float32),
+                        jnp.full((b, h, s), NEG_INF, jnp.float32),
+                    ),
+                    lambda kv: flash_block(kv[0], kv[1], True),
+                    lambda kv: flash_block(kv[0], kv[1], False),
+                ],
+                (k_cur, v_cur),
             )
         else:
-            o_acc, m_acc, l_acc = attend(o_acc, m_acc, l_acc)
-        return (o_acc, m_acc, l_acc, k_cur, v_cur), None
+            o_i, lse_i = flash_block(k_cur, v_cur, False)
 
-    b, s, h, d = q.shape
+        # Merge normalized partials by lse weight (online softmax across
+        # blocks): exact full attention once all blocks have contributed.
+        lse_new = jnp.logaddexp(lse_acc, lse_i)
+        w_acc = jnp.exp(lse_acc - lse_new)[..., None].transpose(0, 2, 1, 3)
+        w_i = jnp.exp(lse_i - lse_new)[..., None].transpose(0, 2, 1, 3)
+        o_new = o_acc * w_acc + o_i * w_i
+        return (o_new, lse_new, k_cur, v_cur), None
+
     o0 = jnp.zeros((b, s, h, d), jnp.float32)
-    m0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, s), jnp.float32)
-    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
-    l = jnp.maximum(l, 1e-30)
-    out = o / l[..., None].transpose(0, 2, 1, 3)
-    return out.astype(q.dtype)
+    lse0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
+    (o, _, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
+    return o.astype(q.dtype)
 
 
 def ring_attention(
@@ -140,11 +132,18 @@ def ring_attention(
 
 def batch_seq_spec(mesh: Mesh, axis: str) -> PartitionSpec:
     """[batch, seq, heads, head_dim] spec: batch over the mesh's batch axes
-    ("data"/"fsdp" when present), sequence over `axis`."""
+    ("data"/"fsdp" when present), sequence over `axis`, heads over
+    "tensor" when the mesh has one (TP x SP compose: each tensor shard
+    runs the ring over its own head group)."""
     if axis not in mesh.axis_names:
         raise ValueError(f"mesh has no axis {axis!r}; axes: {mesh.axis_names}")
     batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
-    return PartitionSpec(batch_axes if batch_axes else None, axis, None, None)
+    head_axis = (
+        "tensor"
+        if "tensor" in mesh.axis_names and mesh.shape["tensor"] > 1
+        else None
+    )
+    return PartitionSpec(batch_axes if batch_axes else None, axis, head_axis, None)
 
 
 def attention_reference(q, k, v, *, causal: bool = True, scale: Optional[float] = None):

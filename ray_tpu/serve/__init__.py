@@ -3,11 +3,13 @@ SURVEY.md §2f): controller/reconciler, p2c router, replicas, HTTP proxy,
 queue-depth autoscaling."""
 
 from .api import delete, get_app_handle, run, shutdown
+from .batching import batch, get_multiplexed_model_id, multiplexed
 from .deployment import Application, AutoscalingConfig, Deployment, DeploymentConfig, deployment
 from .handle import DeploymentHandle, DeploymentResponse, start_proxy, stop_proxy
 
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
-    "DeploymentHandle", "DeploymentResponse", "delete", "deployment",
-    "get_app_handle", "run", "shutdown", "start_proxy", "stop_proxy",
+    "DeploymentHandle", "DeploymentResponse", "batch", "delete", "deployment",
+    "get_app_handle", "get_multiplexed_model_id", "multiplexed", "run",
+    "shutdown", "start_proxy", "stop_proxy",
 ]

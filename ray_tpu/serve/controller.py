@@ -46,7 +46,7 @@ class Replica:
         # primitive).
         self._streams: Dict[str, Any] = {}
 
-    def handle_request(self, method: str, args, kwargs):
+    def handle_request(self, method: str, args, kwargs, context=None):
         import asyncio
         import inspect
         import queue as _queue
@@ -57,6 +57,16 @@ class Replica:
             self._total += 1
         streaming = False
         try:
+            # Per-request context (multiplexed model id etc.) for
+            # serve.get_multiplexed_model_id() inside the callable
+            # (reference: serve/context.py _serve_request_context).
+            # ALWAYS set: pool threads are reused, and a stale model id
+            # from the previous request must not leak into this one.
+            from .batching import set_request_context
+
+            set_request_context(
+                multiplexed_model_id=(context or {}).get("multiplexed_model_id", "")
+            )
             fn = self._callable if method == "__call__" else getattr(self._callable, method)
             if method == "__call__" and not callable(self._callable):
                 raise TypeError("deployment target is not callable")
@@ -197,6 +207,9 @@ class ServeController:
         with self._lock:
             redeploy = app_name in self._apps
             old_replicas = self._replicas.get(app_name, []) if redeploy else []
+            old_children = (
+                list(self._apps[app_name].get("children", [])) if redeploy else []
+            )
             self._apps[app_name] = {
                 "cls_blob": cls_blob,
                 "init_args": init_args,
@@ -220,6 +233,11 @@ class ServeController:
                 api.kill(r)
             except Exception:
                 pass
+        # Composition children the new bind no longer references would
+        # otherwise leak their replica actors until controller shutdown.
+        dropped = set(old_children) - set(children or [])
+        for child in dropped:
+            self.delete_app(child)
         self._reconcile()
         return True
 

@@ -123,6 +123,7 @@ class DeploymentHandle:
         self._app = app_name
         self._method = method_name
         self._stream = False
+        self._mux_id: Optional[str] = None
         self._controller = api.get_actor(CONTROLLER_NAME)
         self._version = -1
         self._replicas: List[Any] = []
@@ -136,10 +137,13 @@ class DeploymentHandle:
         # resolved controller actor, lock, and replica cache are
         # process-local (reference: serve handles are serializable and
         # re-resolve server-side).
-        return (_rebuild_handle, (self._app, self._method, self._stream))
+        return (_rebuild_handle, (self._app, self._method, self._stream, self._mux_id))
 
     def options(
-        self, method_name: Optional[str] = None, stream: Optional[bool] = None
+        self,
+        method_name: Optional[str] = None,
+        stream: Optional[bool] = None,
+        multiplexed_model_id: Optional[str] = None,
     ) -> "DeploymentHandle":
         h = DeploymentHandle.__new__(DeploymentHandle)
         h.__dict__.update(self.__dict__)
@@ -147,6 +151,8 @@ class DeploymentHandle:
             h._method = method_name
         if stream is not None:
             h._stream = stream
+        if multiplexed_model_id is not None:
+            h._mux_id = multiplexed_model_id
         return h
 
     def _refresh(self, force: bool = False) -> None:
@@ -161,12 +167,22 @@ class DeploymentHandle:
 
     def _choose_replica(self):
         """Power of two choices over client-side outstanding counts
-        (reference: pow_2_scheduler.py:813)."""
+        (reference: pow_2_scheduler.py:813). Multiplexed requests route by
+        model-id hash instead: the same model consistently lands on the
+        same replica, so its weights stay resident in that replica's HBM
+        (reference: the model-locality ranking in
+        replica_scheduler/pow_2_scheduler — collapsed to consistent
+        hashing, which needs no cross-client model registry)."""
         self._refresh()
         if not self._replicas:
             raise RuntimeError(f"no replicas for app {self._app!r}")
         if len(self._replicas) == 1:
             return self._replicas[0]
+        if self._mux_id is not None:
+            import zlib
+
+            idx = zlib.crc32(self._mux_id.encode()) % len(self._replicas)
+            return self._replicas[idx]
         a, b = random.sample(self._replicas, 2)
         with self._lock:
             return a if self._outstanding.get(a._id, 0) <= self._outstanding.get(b._id, 0) else b
@@ -182,7 +198,10 @@ class DeploymentHandle:
                 if rid in self._outstanding:
                     self._outstanding[rid] -= 1
 
-        ref = replica.handle_request.remote(self._method, args, kwargs)
+        context = (
+            {"multiplexed_model_id": self._mux_id} if self._mux_id is not None else None
+        )
+        ref = replica.handle_request.remote(self._method, args, kwargs, context)
         response = DeploymentResponse(ref, done, replica=replica)
         if self._stream:
             return DeploymentResponseGenerator(response)
@@ -456,7 +475,10 @@ def stop_proxy() -> None:
         _proxy = None
 
 
-def _rebuild_handle(app_name: str, method_name: str, stream: bool) -> "DeploymentHandle":
+def _rebuild_handle(
+    app_name: str, method_name: str, stream: bool, mux_id: Optional[str] = None
+) -> "DeploymentHandle":
     h = DeploymentHandle(app_name, method_name)
     h._stream = stream
+    h._mux_id = mux_id
     return h

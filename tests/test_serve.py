@@ -306,3 +306,108 @@ def test_deployment_composition(rt):
     out = handle.remote("the quick brown fox").result(timeout=60)
     assert out == {"n_tokens": 4, "tokens": ["the", "quick", "brown", "fox"]}
     serve.shutdown()
+
+
+def test_serve_batch_coalesces(rt):
+    """@serve.batch: a concurrent burst executes as one (or few) batched
+    handler calls (reference: python/ray/serve/batching.py semantics)."""
+    from ray_tpu import serve
+
+    @serve.deployment(max_ongoing_requests=16)
+    class Doubler:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.5)
+        def __call__(self, items):
+            self.batch_sizes.append(len(items))
+            return [i * 2 for i in items]
+
+        def sizes(self):
+            return self.batch_sizes
+
+    handle = serve.run(Doubler.bind(), name="batcher")
+    resps = [handle.remote(i) for i in range(4)]
+    assert sorted(r.result(timeout=30) for r in resps) == [0, 2, 4, 6]
+    sizes = handle.options(method_name="sizes").remote().result(timeout=30)
+    assert sum(sizes) == 4
+    assert max(sizes) >= 2, f"burst never coalesced: {sizes}"
+    serve.shutdown()
+
+
+def test_serve_batch_timeout_flushes_partial(rt):
+    """A lone request must not wait for a full batch: the wait-timeout
+    flushes a partial batch."""
+    import time
+
+    from ray_tpu import serve
+
+    @serve.deployment(max_ongoing_requests=8)
+    class One:
+        @serve.batch(max_batch_size=64, batch_wait_timeout_s=0.2)
+        def __call__(self, items):
+            return [len(items)] * len(items)
+
+    handle = serve.run(One.bind(), name="partial")
+    t0 = time.time()
+    assert handle.remote("x").result(timeout=30) == 1  # batch of one
+    assert time.time() - t0 < 10.0
+    serve.shutdown()
+
+
+def test_serve_batch_result_count_mismatch_errors(rt):
+    from ray_tpu import serve
+
+    @serve.deployment
+    class TooMany:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        def __call__(self, items):
+            return items + [None]
+
+    @serve.deployment
+    class TooFew:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        def __call__(self, items):
+            return items[:-1] if len(items) > 1 else []
+
+    handle = serve.run(TooMany.bind(), name="toomany")
+    with pytest.raises(Exception):
+        handle.remote("x").result(timeout=30)
+    handle = serve.run(TooFew.bind(), name="toofew")
+    with pytest.raises(Exception):
+        handle.remote("x").result(timeout=30)
+    serve.shutdown()
+
+
+def test_serve_multiplexed_lru(rt):
+    """@serve.multiplexed: per-replica LRU of loaded models, model id from
+    the request context (reference: serve/api.py:558)."""
+    from ray_tpu import serve
+
+    @serve.deployment  # single replica: deterministic cache behavior
+    class Mux:
+        def __init__(self):
+            self.load_log = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            self.load_log.append(model_id)
+            return {"id": model_id}
+
+        def __call__(self, x):
+            m = self.get_model()
+            return [m["id"], x]
+
+        def loads(self):
+            return self.load_log
+
+    handle = serve.run(Mux.bind(), name="mux")
+    assert handle.options(multiplexed_model_id="a").remote(1).result(timeout=30) == ["a", 1]
+    assert handle.options(multiplexed_model_id="a").remote(2).result(timeout=30) == ["a", 2]
+    assert handle.options(multiplexed_model_id="b").remote(3).result(timeout=30) == ["b", 3]
+    assert handle.options(multiplexed_model_id="c").remote(4).result(timeout=30) == ["c", 4]
+    # "a" was evicted (LRU, cap 2): calling it again re-loads.
+    assert handle.options(multiplexed_model_id="a").remote(5).result(timeout=30) == ["a", 5]
+    load_log = handle.options(method_name="loads").remote().result(timeout=30)
+    assert load_log == ["a", "b", "c", "a"]
+    serve.shutdown()
