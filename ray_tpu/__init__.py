@@ -11,6 +11,7 @@ from . import exceptions  # noqa: F401
 from .api import (  # noqa: F401
     ObjectRef,
     available_resources,
+    broadcast,
     cancel,
     cluster_resources,
     get,
@@ -35,6 +36,7 @@ from .core.placement_group import (  # noqa: F401
 
 __all__ = [
     "__version__",
+    "broadcast",
     "init",
     "shutdown",
     "is_initialized",

@@ -149,7 +149,7 @@ def _build_sched_options(opts: Dict[str, Any], for_actor: bool = False) -> Sched
         raise ValueError(f"invalid option(s) {sorted(bad)}; valid: {sorted(_VALID_OPTIONS)}")
     renv = opts.get("runtime_env")
     if renv:
-        supported = {"env_vars", "working_dir"}
+        supported = {"env_vars", "working_dir", "py_modules", "pip"}
         bad_env = set(renv) - supported
         if bad_env:
             # Honest surface: unsupported runtime-env fields raise instead
@@ -168,6 +168,21 @@ def _build_sched_options(opts: Dict[str, Any], for_actor: bool = False) -> Sched
         wd = renv.get("working_dir")
         if wd is not None and not isinstance(wd, str):
             raise TypeError("runtime_env['working_dir'] must be a path string")
+        mods = renv.get("py_modules")
+        if mods is not None and (
+            not isinstance(mods, (list, tuple))
+            or not all(isinstance(m, str) for m in mods)
+        ):
+            raise TypeError("runtime_env['py_modules'] must be a list of paths")
+        pip = renv.get("pip")
+        if pip is not None and not (
+            isinstance(pip, str)
+            or (isinstance(pip, (list, tuple)) and all(isinstance(p, str) for p in pip))
+        ):
+            raise TypeError(
+                "runtime_env['pip'] must be a requirements list or a "
+                "requirements.txt path"
+            )
     strategy = opts.get("scheduling_strategy") or "DEFAULT"
     pg_id = None
     bundle_index = opts.get("placement_group_bundle_index", -1)
@@ -265,6 +280,10 @@ class RemoteFunction:
             options=_build_sched_options(self._options),
         )
         return_ids = rt.submit_task(spec)
+        if num_returns == "streaming":
+            from .core.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id, rt)
         refs = [ObjectRef(oid, rt) for oid in return_ids]
         return refs[0] if num_returns == 1 else refs
 
@@ -330,6 +349,10 @@ class ActorHandle:
             actor_id=self._actor_id,
         )
         return_ids = rt.submit_actor_task(spec)
+        if num_returns == "streaming":
+            from .core.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id, rt)
         refs = [ObjectRef(oid, rt) for oid in return_ids]
         return refs[0] if num_returns == 1 else refs
 
@@ -479,6 +502,52 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
 
 def cancel(ref: ObjectRef, *, force: bool = False):
     current_runtime().cancel(ref.id(), force=force)
+
+
+def broadcast(ref: ObjectRef, *, timeout: Optional[float] = 60.0) -> int:
+    """Proactively replicates an object to every alive node via a binary
+    push tree — the weight-sync fast path: N nodes receive a B-byte
+    object in ~log2(N) relay rounds instead of N serial pulls from the
+    owner (reference: push-based transfer, push_manager.h:30; the
+    reference triggers pushes from pulls — here the broadcast intent is
+    explicit). Blocks until every node reports a copy (or timeout);
+    returns the number of target nodes."""
+    import time as _time
+
+    rt = current_runtime()
+    raylet = getattr(rt, "_raylet", None)
+    gcs = getattr(rt, "_gcs", None)
+    if raylet is None or gcs is None:
+        return 0  # local mode: nothing to replicate
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    oid = ref.id()
+    # The object must exist locally before it can root the tree (the one
+    # deadline covers both phases).
+    rt.get([oid], timeout=timeout)
+    h = oid.hex()
+    if h in getattr(rt, "_memstore", {}):
+        rt.mark_escaped(oid)  # promote inline results to shm first
+    n = raylet.call("start_broadcast", h)
+    if n <= 0:
+        return 0
+    while True:
+        # Success = every CURRENTLY-alive node holds a copy — a target
+        # dying mid-broadcast must not fail a fan-out that reached all
+        # survivors.
+        try:
+            locs = {l["node_id"] for l in gcs.call("get_object_locations", h)}
+            alive = {
+                node["NodeID"] for node in gcs.call("list_nodes") if node.get("Alive")
+            }
+        except Exception:
+            locs, alive = set(), {None}
+        if alive and alive <= locs:
+            return n
+        if deadline is not None and _time.monotonic() >= deadline:
+            raise exc.GetTimeoutError(
+                f"broadcast of {h[:12]} reached {len(locs & alive)}/{len(alive)} alive nodes"
+            )
+        _time.sleep(0.1)
 
 
 def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:

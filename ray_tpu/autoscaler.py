@@ -27,6 +27,20 @@ class NodeProvider:
         raise NotImplementedError
 
 
+class TPUSliceProvider(NodeProvider):
+    """Provider that can ALSO allocate whole TPU pod slices atomically —
+    one host-node per slice worker, all carrying the slice's name/index
+    labels, appearing together or not at all (reference: the slice-atomic
+    provisioning the `TPU-{pod}-head` resource idiom approximates,
+    accelerators/tpu.py:334-397; here a first-class provider operation,
+    paired with the scheduler's SLICE_GANG strategy)."""
+
+    def create_slice(
+        self, num_hosts: int, tpus_per_host: float, cpus_per_host: float = 2.0
+    ) -> List[str]:
+        raise NotImplementedError
+
+
 class LocalNodeProvider(NodeProvider):
     """Adds raylet processes to a local Cluster (the test/e2e provider)."""
 
@@ -41,6 +55,40 @@ class LocalNodeProvider(NodeProvider):
 
     def terminate_node(self, node_id: str) -> None:
         self._cluster.remove_node(node_id)
+
+
+class LocalTPUSliceProvider(LocalNodeProvider, TPUSliceProvider):
+    """Fake slice provider over the local Cluster fixture (reference:
+    autoscaler/_private/fake_multi_node/node_provider.py:236
+    FakeMultiNodeProvider — the reference's autoscaler e2e test double)."""
+
+    def __init__(self, cluster, num_cpus_per_node: float = 2.0):
+        super().__init__(cluster, num_cpus_per_node)
+        self._slice_seq = 0
+
+    def create_slice(
+        self, num_hosts: int, tpus_per_host: float, cpus_per_host: float = 2.0
+    ) -> List[str]:
+        self._slice_seq += 1
+        slice_name = f"fake-slice-{self._slice_seq}"
+        nodes = []
+        try:
+            for i in range(num_hosts):
+                nodes.append(
+                    self._cluster.add_node(
+                        resources={"CPU": cpus_per_host, "TPU": tpus_per_host},
+                        labels={"slice_name": slice_name, "worker_index": i},
+                    )
+                )
+        except Exception:
+            # Atomicity: a partial slice is useless to a gang — tear it down.
+            for nid in nodes:
+                try:
+                    self.terminate_node(nid)
+                except Exception:
+                    pass
+            raise
+        return nodes
 
 
 class Autoscaler:
@@ -68,6 +116,12 @@ class Autoscaler:
         self._managed: List[str] = []  # nodes this autoscaler created
         self._idle_since: Dict[str, float] = {}
         self._demand_since: Optional[float] = None
+        self._gang_demand_since: Dict[str, float] = {}
+        # pg_id -> provision timestamp: re-provision if a gang is STILL
+        # pending long after its slice was created (a slice host died
+        # mid-provision); pruned when the pg schedules or disappears.
+        self._gangs_provisioned: Dict[str, float] = {}
+        self.gang_reprovision_s = 60.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.num_upscales = 0
@@ -119,6 +173,50 @@ class Autoscaler:
                 self._demand_since = None
         else:
             self._demand_since = None
+
+        # ---- gang upscale: pending SLICE_GANG groups need a whole slice
+        # (reference: the autoscaler state service reading PG demand,
+        # gcs_autoscaler_state_manager.h:30 — a gang is slice-shaped
+        # demand the provider must satisfy atomically)
+        if isinstance(self._provider, TPUSliceProvider):
+            try:
+                pgs = gcs.call("placement_group_table")
+            except Exception:
+                pgs = {}
+            for stale in [g for g in self._gangs_provisioned if g not in pgs
+                          or pgs[g].get("state") != "PENDING"]:
+                self._gangs_provisioned.pop(stale, None)
+            for pg_id, pg in pgs.items():
+                if pg.get("state") != "PENDING" or pg.get("strategy") != "SLICE_GANG":
+                    self._gang_demand_since.pop(pg_id, None)
+                    continue
+                provisioned_at = self._gangs_provisioned.get(pg_id)
+                if (
+                    provisioned_at is not None
+                    and now - provisioned_at < self.gang_reprovision_s
+                ):
+                    continue  # slice on the way; give placement time
+                first = self._gang_demand_since.setdefault(pg_id, now)
+                if now - first < self.upscale_delay_s:
+                    continue
+                bundles = pg.get("bundles") or []
+                if len(alive) + len(bundles) > self.max_nodes:
+                    continue
+                tpus = max((b.get("TPU", 0.0) for b in bundles), default=0.0)
+                cpus = max((b.get("CPU", 1.0) for b in bundles), default=1.0)
+                self._managed.extend(
+                    self._provider.create_slice(
+                        len(bundles), tpus, cpus_per_host=max(1.0, cpus)
+                    )
+                )
+                self._gangs_provisioned[pg_id] = now
+                self.num_upscales += 1
+                # Nudge placement now that the slice exists; the waiter's
+                # ready() poll would get there anyway.
+                try:
+                    gcs.call("retry_pending_placement_group", pg_id)
+                except Exception:
+                    pass
 
         # ---- downscale: managed nodes idle past the timeout
         for n in alive:

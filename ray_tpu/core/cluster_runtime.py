@@ -48,6 +48,7 @@ def _entry_from_spec(spec: TaskSpec) -> dict:
     resources = dict(spec.options.resources.to_dict()) if spec.options.resources else {}
     if spec.task_type == TaskType.NORMAL_TASK and not resources:
         resources = {"CPU": 1.0}
+    streaming = spec.num_returns == "streaming"
     return {
         "task_id": spec.task_id.hex(),
         "func_blob": spec.func_blob,
@@ -55,7 +56,18 @@ def _entry_from_spec(spec: TaskSpec) -> dict:
         "method_name": spec.method_name,
         "args_blob": cloudpickle.dumps((spec.args, spec.kwargs)),
         "deps": deps,
-        "return_ids": [spec.task_id.object_id_for_return(i).hex() for i in range(spec.num_returns)],
+        # Streaming tasks pre-declare only the header (index 0); item ids
+        # are derived as the generator yields (reference: dynamic return
+        # ids of streaming generators, _raylet.pyx).
+        "return_ids": (
+            [spec.task_id.object_id_for_return(0).hex()]
+            if streaming
+            else [
+                spec.task_id.object_id_for_return(i).hex()
+                for i in range(spec.num_returns)
+            ]
+        ),
+        "streaming": streaming,
         "resources": resources,
         "actor_id": spec.actor_id.hex() if spec.actor_id else None,
         "max_restarts": spec.options.max_restarts,
@@ -160,6 +172,11 @@ class ClusterRuntime(Runtime):
         # in-memory store, src/ray/core_worker/store_provider/memory_store/).
         self._memstore: Dict[str, bytes] = {}
         self._memstore_bytes = 0
+        # Streaming tasks this owner is consuming: their dynamically-
+        # discovered item oids (hex prefix == task id) are accepted into
+        # the memory store even before adoption into _owned.
+        self._stream_tasks: set = set()
+        self._renv_cache: Dict[str, dict] = {}
         # Stream worker stdout/stderr to the driver console (reference:
         # log_monitor.py tailing worker logs to the driver; disable with
         # RAY_TPU_LOG_TO_DRIVER=0). Remote clients (tcp:// raylet, no
@@ -189,6 +206,8 @@ class ClusterRuntime(Runtime):
             for h, blob in inline.items():
                 with self._ref_lock:
                     wanted = h in self._owned
+                if not wanted:
+                    wanted = h[:24] in self._stream_tasks  # stream item
                 if not wanted:
                     # Every ref was dropped while the task was in flight
                     # (fire-and-forget): storing the late result would leak
@@ -829,6 +848,99 @@ class ClusterRuntime(Runtime):
                     except Exception:
                         pass
 
+    # --------------------------------------------- streaming returns
+    def stream_next(self, task_id, index: int, timeout: Optional[float] = None):
+        """Next item oid of a streaming task, or None at end of stream.
+
+        Items land incrementally (inline stream acks on the direct path,
+        seal notifications otherwise); the header at return index 0 closes
+        the stream with the item count."""
+        from .object_ref import STREAM_COUNT_KEY
+
+        header_oid = task_id.object_id_for_return(0)
+        item_oid = task_id.object_id_for_return(index + 1)
+        h_item, h_header = item_oid.hex(), header_oid.hex()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        last_remote_check = 0.0
+        while True:
+            if h_item in self._memstore or self._store.contains(item_oid):
+                self._adopt_stream_item(h_item)
+                return item_oid
+            if h_header in self._memstore or self._store.contains(header_oid):
+                hdr = self._get_one(header_oid, None)  # raises task errors
+                if index >= hdr.get(STREAM_COUNT_KEY, 0):
+                    return None
+                # Item exists somewhere but is not local yet: fall through
+                # to the wait (the raylet path below pulls it in).
+            if deadline is not None and time.monotonic() >= deadline:
+                raise exc.GetTimeoutError(
+                    f"stream item {index} of {task_id.hex()[:12]} timed out"
+                )
+            now = time.monotonic()
+            if now - last_remote_check > 2.0:
+                # Periodic raylet-side wait: pulls items produced on other
+                # nodes and covers lost acks (same safety net as _get_one).
+                last_remote_check = now
+                try:
+                    self._raylet.call(
+                        "wait_objects", [h_item, h_header], 1, 0.2, True, timeout=10.0
+                    )
+                except Exception:
+                    pass
+                # Producer-death safety net: the header's task record drives
+                # retry/reconstruct or raises ObjectLostError — without this
+                # a stream whose producing NODE died would block forever.
+                self._maybe_recover(header_oid)
+                continue
+            with self._fast_seal_cv:
+                self._fast_seal_cv.wait(timeout=0.05)
+
+    def _adopt_stream_item(self, h: str) -> None:
+        """First sight of a dynamically-created stream item: this process
+        owns it (it owns the producing task). Inline items free locally;
+        shm items ride the GCS directory path like normal returns."""
+        with self._ref_lock:
+            if h in self._owned:
+                return
+            self._owned.add(h)
+            if h not in self._memstore:
+                self._escaped.add(h)
+
+    def stream_done(self, task_id) -> None:
+        prefix = task_id.hex()[:24]
+        with self._fast_seal_cv:
+            self._stream_tasks.discard(prefix)
+        # Purge never-adopted inline items (consumer stopped early).
+        for h in [k for k in self._memstore if k.startswith(prefix)]:
+            with self._ref_lock:
+                if h in self._owned:
+                    continue
+            blob = self._memstore.pop(h, None)
+            if blob is not None:
+                self._memstore_bytes -= len(blob)
+        # Never-adopted shm items (abandoned mid-stream / trailing items):
+        # adopt-and-drop so they ride the normal free path.
+        from .object_ref import STREAM_COUNT_KEY
+
+        header_oid = task_id.object_id_for_return(0)
+        try:
+            if self._store.contains(header_oid):
+                hdr = self._get_one(header_oid, 0.5)
+                count = int(hdr.get(STREAM_COUNT_KEY, 0))
+                for i in range(count):
+                    oid = task_id.object_id_for_return(i + 1)
+                    h = oid.hex()
+                    with self._ref_lock:
+                        if h in self._owned:
+                            continue  # adopted: the user's ref frees it
+                        if not self._store.contains(oid):
+                            continue
+                        self._owned.add(h)
+                        self._local_refs[h] = self._local_refs.get(h, 0) + 1
+                    self.remove_local_ref(oid)
+        except Exception:
+            pass  # abandoned stream cleanup is best effort
+
     def object_future(self, object_id: ObjectID) -> concurrent.futures.Future:
         fut: concurrent.futures.Future = concurrent.futures.Future()
 
@@ -842,9 +954,31 @@ class ClusterRuntime(Runtime):
         return fut
 
     # -------------------------------------------------------------- tasks
+    def _process_renv(self, spec: TaskSpec) -> None:
+        """Driver-side runtime-env normalization: local working_dir /
+        py_modules directories become content-addressed GCS packages
+        (cached per env dict so a task loop zips once, not per call)."""
+        renv = spec.options.runtime_env
+        if not renv:
+            return
+        key = json.dumps(renv, sort_keys=True, default=str)
+        cached = self._renv_cache.get(key)
+        if cached is None:
+            from .runtime_env import process_runtime_env
+
+            cached = process_runtime_env(renv, self._gcs)
+            self._renv_cache[key] = cached
+        spec.options.runtime_env = cached
+
     def submit_task(self, spec: TaskSpec) -> List[ObjectID]:
+        self._process_renv(spec)
         entry = _entry_from_spec(spec)
         spec.return_ids = [ObjectID.from_hex(h) for h in entry["return_ids"]]
+        if entry.get("streaming"):
+            with self._fast_seal_cv:
+                # Keyed by the 12-byte task prefix (first 24 hex chars of
+                # any of the task's object ids).
+                self._stream_tasks.add(spec.task_id.hex()[:24])
         self._record_submission(entry, "task")
         # Bundle-pinned tasks route straight to the node holding the reserved
         # bundle (reference: bundle scheduling bypasses the hybrid policy,
@@ -853,6 +987,7 @@ class ClusterRuntime(Runtime):
         return spec.return_ids
 
     def create_actor(self, spec: TaskSpec) -> ActorID:
+        self._process_renv(spec)
         actor_id = spec.actor_id or ActorID.from_random()
         spec.actor_id = actor_id
         entry = _entry_from_spec(spec)
@@ -912,6 +1047,9 @@ class ClusterRuntime(Runtime):
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectID]:
         entry = _entry_from_spec(spec)
         spec.return_ids = [ObjectID.from_hex(h) for h in entry["return_ids"]]
+        if entry.get("streaming"):
+            with self._fast_seal_cv:
+                self._stream_tasks.add(spec.task_id.hex()[:24])
         self._record_submission(entry, "actor_task")
         self._actor_channel(spec.actor_id.hex()).submit(entry)
         return spec.return_ids
@@ -1021,7 +1159,17 @@ class ClusterRuntime(Runtime):
         from .placement_group import PlacementGroupHandle
 
         pg_id = uuid.uuid4().hex
-        result = self._gcs.call("create_placement_group", pg_id, bundles, strategy)
+        try:
+            result = self._gcs.call("create_placement_group", pg_id, bundles, strategy)
+        except Exception:
+            # Cannot be placed NOW: register as PENDING — creation is
+            # asynchronous as in the reference (gcs_placement_group_manager
+            # PENDING + autoscaler demand); ready()/wait() poll until
+            # capacity (e.g. an autoscaled slice) arrives.
+            self._gcs.call(
+                "register_pending_placement_group", pg_id, bundles, strategy
+            )
+            result = {"placements": []}
         handle = PlacementGroupHandle(pg_id, bundles, strategy, name)
         handle.bundle_placements = dict(enumerate(result["placements"]))
         return handle
@@ -1030,7 +1178,21 @@ class ClusterRuntime(Runtime):
         self._gcs.call("remove_placement_group", pg_id)
 
     def placement_group_ready(self, pg_id, timeout=None) -> bool:
-        return self._gcs.call("get_placement_group", pg_id) is not None
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            info = self._gcs.call("get_placement_group", pg_id)
+            if info is not None and info.get("state") == "CREATED":
+                return True
+            if info is not None and info.get("state") == "PENDING":
+                # Poller-driven retry: capacity may have arrived since.
+                try:
+                    if self._gcs.call("retry_pending_placement_group", pg_id):
+                        return True
+                except Exception:
+                    pass
+            if deadline is None or time.monotonic() >= deadline:
+                return info is not None and info.get("state") == "CREATED"
+            time.sleep(0.25)
 
     def placement_group_table(self) -> Dict[str, dict]:
         return self._gcs.call("placement_group_table")

@@ -138,6 +138,10 @@ class DirectConn:
                     self._on_sealed(msg[3], msg[4] if len(msg) > 4 else None)
                 if drained:
                     break  # revoked lease fully drained: close it
+            elif msg[0] == "si":  # stream item: ("si", sealed, inline)
+                self.last_used = time.monotonic()
+                if self._on_sealed is not None:
+                    self._on_sealed(msg[1], msg[2])
             elif msg[0] == "r":
                 # Lease revoked by the raylet (queued work needs the
                 # resources): stop new pushes, close once drained.
@@ -178,6 +182,7 @@ def task_frame(entry: dict, conn: DirectConn) -> tuple:
         entry["args_blob"],
         entry["return_ids"],
         entry.get("desc", ""),
+        bool(entry.get("streaming")),
     )
 
 
@@ -190,6 +195,7 @@ def actor_frame(entry: dict) -> tuple:
         entry["args_blob"],
         entry["return_ids"],
         entry.get("desc", ""),
+        bool(entry.get("streaming")),
     )
 
 

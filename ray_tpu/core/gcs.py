@@ -57,6 +57,7 @@ class GcsService:
         # while borrowers hold the ref, and a freed object that seals late
         # (free raced the task) is deleted on arrival.
         self._removed_pgs: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
+        self._pg_creating: Set[str] = set()  # pending-PG retry in flight
         self._borrows: Dict[str, int] = {}
         self._deferred_free: Set[str] = set()
         self._free_queue: List[Tuple[float, List[str]]] = []
@@ -1114,6 +1115,58 @@ class GcsService:
                 "store": n["store"],
                 "bundle_index": bundle_index,
             }
+
+    def register_pending_placement_group(
+        self, pg_id: str, bundles: List[dict], strategy: str
+    ) -> bool:
+        """Records a PG the cluster cannot place YET (reference: the
+        PENDING state of gcs_placement_group_manager.h:230 — creation is
+        asynchronous; the autoscaler watches pending groups and provisions
+        capacity for them)."""
+        with self._lock:
+            if pg_id in self._removed_pgs or pg_id in self._pgs:
+                return False
+            self._pgs[pg_id] = {
+                "bundles": bundles,
+                "strategy": strategy,
+                "placements": [],
+                "state": "PENDING",
+                "rr": 0,
+            }
+        return True
+
+    def retry_pending_placement_group(self, pg_id: str) -> Optional[dict]:
+        """Attempts to place a PENDING group (invoked by ready() pollers —
+        new capacity may have arrived). One attempt in flight per group."""
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            if pg is None:
+                return None
+            if pg.get("state") == "CREATED":
+                return {"placements": pg["placements"]}
+            if pg.get("state") != "PENDING" or pg_id in self._pg_creating:
+                return None
+            self._pg_creating.add(pg_id)
+            bundles, strategy = pg["bundles"], pg["strategy"]
+        try:
+            with self._lock:
+                del self._pgs[pg_id]  # create() re-registers on success
+            try:
+                return self.create_placement_group(pg_id, bundles, strategy)
+            except RuntimeError:
+                with self._lock:
+                    if pg_id not in self._removed_pgs and pg_id not in self._pgs:
+                        self._pgs[pg_id] = {
+                            "bundles": bundles,
+                            "strategy": strategy,
+                            "placements": [],
+                            "state": "PENDING",
+                            "rr": 0,
+                        }
+                return None
+        finally:
+            with self._lock:
+                self._pg_creating.discard(pg_id)
 
     def placement_group_table(self) -> Dict[str, dict]:
         with self._lock:

@@ -189,6 +189,9 @@ class LocalRuntime(Runtime):
 
     def _store_returns(self, spec: TaskSpec, result: Any) -> None:
         n = spec.num_returns
+        if n == "streaming":
+            self._store_stream(spec, result)
+            return
         if n == 1:
             self._store(spec.return_ids[0], _OK, result)
         else:
@@ -203,6 +206,93 @@ class LocalRuntime(Runtime):
                 return
             for rid, v in zip(spec.return_ids, vals):
                 self._store(rid, _OK, v)
+
+    def _store_stream(self, spec: TaskSpec, result: Any) -> None:
+        """Streaming returns (num_returns="streaming"): item i at return
+        index i+1 as produced, header (count) at index 0 on completion —
+        same layout as the cluster runtime."""
+        from .object_ref import STREAM_COUNT_KEY
+
+        if inspect.isasyncgen(result):
+            agen = result
+
+            def _sync_iter():
+                loop = asyncio.new_event_loop()
+                try:
+                    while True:
+                        try:
+                            yield loop.run_until_complete(agen.__anext__())
+                        except StopAsyncIteration:
+                            return
+                finally:
+                    loop.close()
+
+            result = _sync_iter()
+        it = iter(result)
+        count = 0
+        while True:
+            try:
+                item = next(it)
+            except StopIteration:
+                break
+            except BaseException as e:  # noqa: BLE001
+                err = e if isinstance(e, exc.RayTpuError) else exc.TaskError(
+                    e, task_desc=spec.description()
+                )
+                self._store(spec.task_id.object_id_for_return(count + 1), _ERR, err)
+                count += 1
+                break
+            self._store(spec.task_id.object_id_for_return(count + 1), _OK, item)
+            count += 1
+        self._store(
+            spec.task_id.object_id_for_return(0), _OK, {STREAM_COUNT_KEY: count}
+        )
+
+    def stream_next(self, task_id, index: int, timeout: Optional[float] = None):
+        from .object_ref import STREAM_COUNT_KEY
+
+        header = task_id.object_id_for_return(0)
+        item = task_id.object_id_for_return(index + 1)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._obj_lock:
+                if item in self._objects:
+                    return item  # errors surface at get()
+                hdr = self._objects.get(header)
+            if hdr is not None:
+                status, value = hdr
+                if status == _ERR:
+                    raise value
+                if index >= value.get(STREAM_COUNT_KEY, 0):
+                    with self._obj_lock:
+                        self._futures.pop(item, None)  # never materializes
+                    return None
+            if deadline is not None and time.monotonic() >= deadline:
+                raise exc.GetTimeoutError(
+                    f"stream item {index} of {task_id.hex()[:12]} timed out"
+                )
+            concurrent.futures.wait(
+                [self._future_for(item), self._future_for(header)],
+                timeout=0.1,
+                return_when=concurrent.futures.FIRST_COMPLETED,
+            )
+
+    def stream_done(self, task_id) -> None:
+        """Frees never-consumed stream items (the consumer's ObjectRefs
+        free the consumed ones; the generator's header ref frees the
+        header)."""
+        from .object_ref import STREAM_COUNT_KEY
+
+        with self._obj_lock:
+            hdr = self._objects.get(task_id.object_id_for_return(0))
+        if not hdr or hdr[0] != _OK:
+            return
+        for i in range(int(hdr[1].get(STREAM_COUNT_KEY, 0))):
+            oid = task_id.object_id_for_return(i + 1)
+            with self._obj_lock:
+                if oid not in self._local_refs:
+                    self._objects.pop(oid, None)
+                    self._futures.pop(oid, None)
 
     def _store_error(self, spec: TaskSpec, err: BaseException) -> None:
         if not isinstance(err, exc.RayTpuError):
@@ -243,7 +333,14 @@ class LocalRuntime(Runtime):
 
     # ------------------------------------------------------------- tasks
     def submit_task(self, spec: TaskSpec) -> List[ObjectID]:
-        spec.return_ids = [spec.task_id.object_id_for_return(i) for i in range(spec.num_returns)]
+        spec.return_ids = (
+            [spec.task_id.object_id_for_return(0)]
+            if spec.num_returns == "streaming"
+            else [
+                spec.task_id.object_id_for_return(i)
+                for i in range(spec.num_returns)
+            ]
+        )
         deps = self._pin_deps(spec)
 
         def execute():
@@ -296,7 +393,14 @@ class LocalRuntime(Runtime):
         return actor_id
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectID]:
-        spec.return_ids = [spec.task_id.object_id_for_return(i) for i in range(spec.num_returns)]
+        spec.return_ids = (
+            [spec.task_id.object_id_for_return(0)]
+            if spec.num_returns == "streaming"
+            else [
+                spec.task_id.object_id_for_return(i)
+                for i in range(spec.num_returns)
+            ]
+        )
         with self._actor_lock:
             state = self._actors.get(spec.actor_id)
         if state is None or state.dead:

@@ -81,3 +81,52 @@ class ObjectRef:
     @staticmethod
     def _from_wire(id_bytes: bytes, owner_addr: str) -> "ObjectRef":
         return ObjectRef(ObjectID(id_bytes), owner_addr=owner_addr)
+
+
+STREAM_COUNT_KEY = "__stream_count__"
+
+
+class ObjectRefGenerator:
+    """Iterator over the ObjectRefs of a streaming task
+    (num_returns="streaming"; reference: python/ray/_raylet.pyx:281
+    ObjectRefGenerator / streaming generator returns).
+
+    Each `next()` blocks until the task has yielded its next value, then
+    returns that value's ObjectRef — the consumer overlaps with the
+    producer instead of waiting for the whole task. Item i lives at the
+    task's return index i+1; index 0 is the stream header (item count),
+    written when the generator finishes."""
+
+    def __init__(self, task_id, runtime):
+        self._task_id = task_id
+        self._rt = runtime
+        self._i = 0
+        self._done = False
+        # Own the header: its ref drop is what releases the task record,
+        # lineage pins, and the header object itself (without this, every
+        # streaming call would leak its record + header forever).
+        self._header_ref = ObjectRef(task_id.object_id_for_return(0), runtime)
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        if self._done:
+            raise StopIteration
+        oid = self._rt.stream_next(self._task_id, self._i)
+        if oid is None:
+            self._done = True
+            self._rt.stream_done(self._task_id)
+            raise StopIteration
+        self._i += 1
+        return ObjectRef(oid, self._rt)
+
+    def completed(self) -> bool:
+        return self._done
+
+    def __del__(self):
+        try:
+            if not self._done:
+                self._rt.stream_done(self._task_id)
+        except Exception:
+            pass

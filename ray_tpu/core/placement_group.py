@@ -33,7 +33,15 @@ class PlacementGroupHandle:
     def ready(self, timeout: Optional[float] = None) -> bool:
         from .runtime_base import current_runtime
 
-        return current_runtime().placement_group_ready(self.id_hex, timeout=timeout)
+        rt = current_runtime()
+        ok = rt.placement_group_ready(self.id_hex, timeout=timeout)
+        if ok and not self.bundle_placements:
+            # PENDING at creation (async placement): pick up the bundle
+            # node assignments now that the group is placed.
+            info = rt.placement_group_table().get(self.id_hex)
+            if info:
+                self.bundle_placements = dict(enumerate(info.get("placements", [])))
+        return ok
 
     def wait(self, timeout_seconds: Optional[float] = None) -> bool:
         return self.ready(timeout=timeout_seconds)

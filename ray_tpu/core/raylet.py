@@ -123,6 +123,16 @@ class RayletService:
         # condition because all waiters of this node's store are local).
         self._seal_cv = threading.Condition()
         self._pulling: Set[str] = set()
+        # Object-plane admission control (reference: pull_manager.h:52
+        # prioritized bounded pulls; push_manager.h chunk scheduling):
+        # bounds concurrent inbound pulls and outbound chunk serving so a
+        # fan-in of requesters degrades to queueing, not thrash.
+        self._pull_sem = threading.BoundedSemaphore(
+            max(1, int(CONFIG.max_concurrent_pulls))
+        )
+        self._serve_sem = threading.BoundedSemaphore(
+            max(1, int(CONFIG.max_concurrent_serves))
+        )
         # Batched control-plane updates to the GCS (object locations + task
         # state events), off the task fast path (reference: task events are
         # batched in the reference too, src/ray/core_worker/task_event_buffer.h).
@@ -818,7 +828,13 @@ class RayletService:
         one RPC; large ones stream in transfer_chunk_bytes pieces written
         straight into the preallocated pool region (reference:
         push_manager.h:30 / object_buffer_pool.h chunked transfer — a 1 GiB
-        object never needs a contiguous 1 GiB RPC buffer on either side)."""
+        object never needs a contiguous 1 GiB RPC buffer on either side).
+        Bounded by the pull semaphore: excess pulls queue here instead of
+        saturating memory/NIC (reference: pull_manager admission)."""
+        with self._pull_sem:
+            return self._pull_from_unbounded(sock, oid)
+
+    def _pull_from_unbounded(self, sock: str, oid: ObjectID) -> bool:
         remote = self._remote(sock)
         oid_hex = oid.hex()
         chunk = int(CONFIG.transfer_chunk_bytes)
@@ -861,6 +877,68 @@ class RayletService:
                 # slot would poison every later pull with EEXIST.
                 self.store.delete(oid)
 
+    # ---------------------------------------------------- tree broadcast
+    def push_object(self, oid_hex: str, src_sock: str, targets: List[str]) -> bool:
+        """Receives a broadcast relay: fetch the object from `src_sock`,
+        then fan the remaining targets out as TWO subtrees rooted at their
+        first nodes — N-node broadcast completes in O(log N) rounds with
+        every node uploading at most twice, instead of the O(N) serial
+        pulls the owner would otherwise serve (reference:
+        push_manager.h:30 push-based transfer; the tree shape is the
+        standard broadcast inversion of it)."""
+        threading.Thread(
+            target=self._do_push, args=(oid_hex, src_sock, list(targets)), daemon=True
+        ).start()
+        return True
+
+    def _do_push(self, oid_hex: str, src_sock: str, targets: List[str]) -> None:
+        oid = ObjectID.from_hex(oid_hex)
+        try:
+            if not self.store.contains(oid):
+                if not self._pull_from(src_sock, oid) and not self.store.contains(oid):
+                    # Source lost the object mid-broadcast: the normal pull
+                    # path (GCS directory) is the fallback for our subtree.
+                    if not self.pull_object(oid_hex, timeout=30.0):
+                        return
+                self._notify_sealed([oid_hex], primary=False)
+        except Exception:
+            return
+        self._relay_push(oid_hex, targets)
+
+    def _relay_push(self, oid_hex: str, targets: List[str]) -> None:
+        """Splits targets into two subtrees and notifies their roots."""
+        targets = [t for t in targets if t != self.advertised and t != self.sock_path]
+        if not targets:
+            return
+        mid = (len(targets) + 1) // 2
+        for half in (targets[:mid], targets[mid:]):
+            if not half:
+                continue
+            head, rest = half[0], half[1:]
+            try:
+                self._remote(head).notify(
+                    "push_object", oid_hex, self.advertised, rest
+                )
+            except Exception:
+                # Root unreachable: its subtree still self-heals via the
+                # normal pull path when consumers ask for the object.
+                pass
+
+    def start_broadcast(self, oid_hex: str) -> int:
+        """Driver-facing: pushes a LOCAL object to every other alive node;
+        returns the number of targets."""
+        try:
+            nodes = self.gcs.call("list_nodes")
+        except Exception:
+            return 0
+        targets = [
+            n["sock"]
+            for n in nodes
+            if n.get("Alive") and n["NodeID"] != self.node_id
+        ]
+        self._relay_push(oid_hex, targets)
+        return len(targets)
+
     def object_size(self, oid_hex: str) -> Optional[int]:
         oid = ObjectID.from_hex(oid_hex)
         size = self.store.raw_size(oid)
@@ -877,9 +955,12 @@ class RayletService:
 
     def fetch_object_chunk(self, oid_hex: str, offset: int, length: int) -> Optional[bytes]:
         """Serves one chunk of the framed payload (spilled objects read
-        from disk without restoring)."""
+        from disk without restoring). Chunk-granular admission: with many
+        simultaneous requesters, streams interleave fairly instead of
+        thrashing (reference: push_manager.h chunk scheduling)."""
         oid = ObjectID.from_hex(oid_hex)
-        piece = self.store.read_raw_chunk(oid, offset, length)
+        with self._serve_sem:
+            piece = self.store.read_raw_chunk(oid, offset, length)
         if piece is not None:
             return piece
         with self._spill_lock:
@@ -1549,7 +1630,17 @@ class RayletService:
         if runtime_env:
             desc.setdefault("runtime_env", runtime_env)
         renv = desc.get("runtime_env")
+        py_exe = sys.executable
         if renv:
+            # Materialize dependencies BEFORE spawn: package URIs extract
+            # into the node cache and a pip spec builds/reuses a venv whose
+            # python runs this worker (reference: runtime_env_agent
+            # building the env ahead of worker start; pip.py venv plugin).
+            # Raises on setup failure — the scheduler converts that into a
+            # stored error on the triggering entry.
+            from .runtime_env import materialize_runtime_env
+
+            py_exe, renv = materialize_runtime_env(renv, self.gcs)
             # Apply env_vars at spawn; working_dir is applied by the worker
             # itself (reference: runtime_env_agent building the env).
             for k, v in (renv.get("env_vars") or {}).items():
@@ -1573,7 +1664,7 @@ class RayletService:
         try:
             proc = subprocess.Popen(
                 [
-                    sys.executable,
+                    py_exe,
                     "-m",
                     "ray_tpu.core.worker_proc",
                     self.sock_path,

@@ -127,3 +127,14 @@ class Runtime(ABC):
 
     def is_driver(self) -> bool:
         return True
+
+    # ----- streaming generator returns (num_returns="streaming") ---------
+    def stream_next(self, task_id, index: int, timeout: Optional[float] = None):
+        """Blocks until item `index` of a streaming task exists; returns
+        its ObjectID, or None when the stream ended before `index`."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support streaming returns"
+        )
+
+    def stream_done(self, task_id) -> None:
+        """Consumer finished/abandoned the stream: release tracking."""

@@ -1,0 +1,253 @@
+"""Runtime environments: per-task/actor dependency management.
+
+Re-design of the reference's runtime-env subsystem (reference:
+python/ray/_private/runtime_env/ — pip.py:45 pip/venv plugin,
+packaging.py zip-to-GCS packages, uri_cache.py cached+GC'd URIs,
+working_dir.py / py_modules.py). The shape is the same three stages:
+
+1. DRIVER side (`process_runtime_env`): local `working_dir`/`py_modules`
+   directories are zipped, content-addressed (sha256), and uploaded ONCE
+   into the GCS KV under `pkg:<hash>` — the runtime_env dict that travels
+   in the task spec carries URIs, never file paths, so any node can
+   materialize it.
+2. RAYLET side (`materialize_runtime_env`): before spawning a worker for
+   an env, packages are downloaded+extracted into a node-local content-
+   addressed cache, and a `pip` spec creates a virtualenv (system
+   site-packages visible, so the baked-in jax stack stays importable)
+   keyed by the hash of its requirements; the worker is spawned with the
+   venv's python and env vars pointing at the extracted paths.
+3. WORKER side: chdir into the working dir, prepend py_module paths to
+   sys.path (worker_proc._apply_working_dir).
+
+Caches are GC'd LRU by directory mtime (`gc_cache`), mirroring
+uri_cache.py's used/unused accounting collapsed to one knob.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+PKG_PREFIX = "pkg:"
+DEFAULT_CACHE = os.path.join(tempfile.gettempdir(), "ray_tpu_env_cache")
+MAX_CACHED_PACKAGES = 16
+MAX_CACHED_VENVS = 8
+
+
+# --------------------------------------------------------------- packaging
+def zip_directory(path: str, include_base: bool = False) -> bytes:
+    """Deterministic zip of a directory tree (fixed timestamps so the
+    content hash is stable across rebuilds — reference: packaging.py
+    creating reproducible working_dir packages). `include_base` keeps the
+    directory's own name as the top-level entry — py_modules packages
+    must extract as `<dir>/mymod/...` so `import mymod` works with the
+    extraction dir on sys.path."""
+    buf = io.BytesIO()
+    base = os.path.basename(os.path.normpath(path)) if include_base else None
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs.sort()
+            if "__pycache__" in dirs:
+                dirs.remove("__pycache__")
+            for name in sorted(files):
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, path)
+                if base is not None:
+                    rel = os.path.join(base, rel)
+                info = zipfile.ZipInfo(rel, date_time=(1980, 1, 1, 0, 0, 0))
+                info.external_attr = (os.stat(full).st_mode & 0xFFFF) << 16
+                with open(full, "rb") as f:
+                    zf.writestr(info, f.read())
+    return buf.getvalue()
+
+
+def upload_package(gcs, path: str, include_base: bool = False) -> str:
+    """Zips + uploads a local directory to the GCS KV; returns its URI.
+    Content-addressed: identical trees dedupe to one upload."""
+    blob = zip_directory(path, include_base=include_base)
+    digest = hashlib.sha256(blob).hexdigest()[:24]
+    uri = f"{PKG_PREFIX}{digest}"
+    if gcs.call("kv_get", f"__pkg__/{digest}") is None:
+        gcs.call("kv_put", f"__pkg__/{digest}", blob)
+    return uri
+
+
+def process_runtime_env(renv: Optional[dict], gcs) -> Optional[dict]:
+    """Driver-side normalization: local dirs -> uploaded package URIs.
+    Idempotent (URIs pass through)."""
+    if not renv:
+        return renv
+    out = dict(renv)
+    wd = out.get("working_dir")
+    if wd and not wd.startswith(PKG_PREFIX) and os.path.isdir(wd):
+        out["working_dir"] = upload_package(gcs, wd)
+    mods = out.get("py_modules")
+    if mods:
+        uris = []
+        for m in mods:
+            if isinstance(m, str) and not m.startswith(PKG_PREFIX) and os.path.isdir(m):
+                uris.append(upload_package(gcs, m, include_base=True))
+            else:
+                uris.append(m)
+        out["py_modules"] = uris
+    pip = out.get("pip")
+    if isinstance(pip, str):
+        # requirements.txt path: inline its lines so the env hash captures
+        # content, not the path (reference: pip.py reading requirements).
+        with open(pip) as f:
+            out["pip"] = [
+                ln.strip() for ln in f if ln.strip() and not ln.startswith("#")
+            ]
+    return out
+
+
+# ------------------------------------------------------------ materialize
+def _fetch_package(gcs, uri: str, cache_dir: str) -> str:
+    """Ensures `pkg:<hash>` is extracted locally; returns its directory."""
+    digest = uri[len(PKG_PREFIX):]
+    dest = os.path.join(cache_dir, "pkgs", digest)
+    if os.path.isdir(dest):
+        os.utime(dest)  # LRU touch
+        return dest
+    blob = gcs.call("kv_get", f"__pkg__/{digest}")
+    if blob is None:
+        raise FileNotFoundError(f"package {uri} not in GCS KV")
+    tmp = dest + f".tmp{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+        zf.extractall(tmp)
+    try:
+        os.rename(tmp, dest)
+    except OSError:
+        shutil.rmtree(tmp, ignore_errors=True)  # raced another worker
+    return dest
+
+
+def _venv_python(pip_spec: List[str], cache_dir: str) -> str:
+    """Creates (or reuses) a virtualenv with `pip_spec` installed; returns
+    its python executable (reference: pip.py:45 building the per-env
+    virtualenv with inherited site-packages)."""
+    digest = hashlib.sha256(
+        json.dumps([sys.executable, sorted(pip_spec)]).encode()
+    ).hexdigest()[:24]
+    venv_dir = os.path.join(cache_dir, "venvs", digest)
+    py = os.path.join(venv_dir, "bin", "python")
+    ready = os.path.join(venv_dir, ".ready")
+    lock = venv_dir + ".lock"
+    if os.path.exists(ready):
+        os.utime(venv_dir)
+        return py
+    os.makedirs(os.path.dirname(venv_dir), exist_ok=True)
+    # Cross-process creation lock (concurrent spawns for the same env).
+    import fcntl
+
+    with open(lock, "w") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(ready):
+                return py
+            if os.path.isdir(venv_dir):
+                shutil.rmtree(venv_dir, ignore_errors=True)
+            subprocess.run(
+                [sys.executable, "-m", "venv", "--system-site-packages", venv_dir],
+                check=True,
+                capture_output=True,
+            )
+            # --system-site-packages points at the BASE interpreter's
+            # site; when this process itself runs in a venv (the usual
+            # case here), the parent's packages (jax, setuptools, ...)
+            # would be invisible. A .pth appends the parent's
+            # site-packages AFTER the venv's own, so pip-installed
+            # packages still shadow inherited ones.
+            parent_sites = [p for p in sys.path if p.rstrip("/").endswith("site-packages")]
+            if parent_sites:
+                import glob as _glob
+
+                for site_dir in _glob.glob(
+                    os.path.join(venv_dir, "lib", "python*", "site-packages")
+                ):
+                    with open(os.path.join(site_dir, "_parent_sites.pth"), "w") as f:
+                        f.write("\n".join(parent_sites) + "\n")
+            if pip_spec:
+                subprocess.run(
+                    [py, "-m", "pip", "install", "--no-input", *pip_spec],
+                    check=True,
+                    capture_output=True,
+                )
+            with open(ready, "w") as f:
+                f.write("ok")
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"runtime_env pip setup failed: {e.stderr.decode(errors='replace')[-2000:]}"
+            ) from e
+        finally:
+            fcntl.flock(lf, fcntl.LOCK_UN)
+    return py
+
+
+def materialize_runtime_env(
+    renv: Optional[dict], gcs, cache_dir: str = DEFAULT_CACHE
+) -> Tuple[str, dict]:
+    """Node-side realization before worker spawn: returns
+    (python_executable, resolved_env) where resolved_env has local paths
+    for working_dir/py_modules. Cheap when everything is cached."""
+    if not renv:
+        return sys.executable, {}
+    os.makedirs(cache_dir, exist_ok=True)
+    resolved = dict(renv)
+    wd = resolved.get("working_dir")
+    if wd and wd.startswith(PKG_PREFIX):
+        resolved["working_dir"] = _fetch_package(gcs, wd, cache_dir)
+    mods = resolved.get("py_modules")
+    if mods:
+        paths = []
+        for m in mods:
+            if isinstance(m, str) and m.startswith(PKG_PREFIX):
+                paths.append(_fetch_package(gcs, m, cache_dir))
+            else:
+                paths.append(m)
+        resolved["py_modules"] = paths
+    py = sys.executable
+    pip = resolved.get("pip")
+    if pip:
+        py = _venv_python(list(pip), cache_dir)
+    gc_cache(cache_dir)
+    return py, resolved
+
+
+MIN_EVICT_AGE_S = 3600.0  # never evict anything touched within the hour
+
+
+def gc_cache(cache_dir: str = DEFAULT_CACHE) -> None:
+    """Evicts least-recently-used packages/venvs beyond the caps
+    (reference: uri_cache.py size-capped GC of unused URIs). Entries
+    touched within MIN_EVICT_AGE_S are never evicted regardless of the
+    cap — a recently-materialized env is very likely backing a LIVE
+    worker (the reference keeps explicit used/unused accounting; the age
+    gate is the collapsed version, trading a bounded cache overshoot for
+    not deleting a running worker's interpreter)."""
+    now = time.time()
+    for sub, cap in (("pkgs", MAX_CACHED_PACKAGES), ("venvs", MAX_CACHED_VENVS)):
+        root = os.path.join(cache_dir, sub)
+        try:
+            entries = [
+                (os.path.getmtime(os.path.join(root, d)), os.path.join(root, d))
+                for d in os.listdir(root)
+                if not d.endswith(".lock") and not d.endswith(".tmp")
+            ]
+        except OSError:
+            continue
+        entries.sort(reverse=True)
+        for mtime, path in entries[cap:]:
+            if now - mtime < MIN_EVICT_AGE_S:
+                continue
+            shutil.rmtree(path, ignore_errors=True)

@@ -65,10 +65,17 @@ def _resolve_args(store, args_blob: bytes, raylet=None):
 
 
 def _apply_working_dir(runtime_env: dict) -> None:
+    """Applies the node-resolved runtime env: cwd + import paths
+    (reference: working_dir.py chdir + py_modules.py sys.path entries;
+    paths here are already local — the raylet materialized any package
+    URIs before spawning us)."""
     wd = (runtime_env or {}).get("working_dir")
     if wd:
         os.chdir(wd)
         sys.path.insert(0, wd)
+    for p in reversed((runtime_env or {}).get("py_modules") or []):
+        if isinstance(p, str) and p not in sys.path:
+            sys.path.insert(0, p)
 
 
 class _AsyncLoop:
@@ -146,7 +153,103 @@ def main(argv: List[str]) -> None:
 
     INLINE_MAX = 64 * 1024  # results below this ride the completion ack
 
+    def _put_value(entry: dict, rid: ObjectID, value: Any, sealed: List[str]):
+        """Stores one return value; returns an inline-blob dict when the
+        value rode the ack instead of shm."""
+        inline = entry.get("_inline")
+        if inline is not None:
+            try:
+                blob = serialization.pack(value)
+            except Exception:
+                blob = None
+            if blob is not None and len(blob) <= INLINE_MAX:
+                return {rid.hex(): blob}
+            if blob is not None:
+                try:
+                    store.put_raw(rid, blob)
+                    sealed.append(rid.hex())
+                    return None
+                except exc.ObjectStoreFullError:
+                    pass
+        store.put_with_pressure(
+            rid, value, raylet, pre_pressure=runtime.flush_local_frees
+        )
+        sealed.append(rid.hex())
+        return None
+
+    def _store_stream(entry: dict, result: Any, sealed: List[str]) -> None:
+        """Streaming returns: each yielded value becomes return object
+        index i+1, delivered to the owner AS PRODUCED (in-band stream acks
+        on the direct path, seal notifications otherwise); the header at
+        index 0 carries the final count (reference: the streaming
+        generator protocol of _raylet.pyx:281 — per-yield object reports).
+        A mid-stream exception is stored AT its item index, surfacing when
+        the consumer reaches it."""
+        import inspect as _inspect
+
+        from .ids import TaskID
+        from .object_ref import STREAM_COUNT_KEY
+
+        tid = TaskID.from_hex(entry["task_id"])
+        report = entry.get("_stream_report")
+
+        if _inspect.isasyncgen(result):
+            agen = result
+
+            def _sync_iter():
+                import asyncio
+
+                loop = asyncio.new_event_loop()
+                try:
+                    while True:
+                        try:
+                            yield loop.run_until_complete(agen.__anext__())
+                        except StopAsyncIteration:
+                            return
+                finally:
+                    loop.close()
+
+            result = _sync_iter()
+        it = iter(result)
+        count = 0
+        while True:
+            try:
+                item = next(it)
+            except StopIteration:
+                break
+            except BaseException as e:  # noqa: BLE001
+                err = e if isinstance(e, exc.RayTpuError) else exc.TaskError(
+                    e, task_desc=entry.get("desc", "")
+                )
+                rid = tid.object_id_for_return(count + 1)
+                item_sealed: List[str] = []
+                inline_d = _put_value(
+                    entry, rid, StoredError(err, entry.get("desc", "")), item_sealed
+                )
+                if report is not None:
+                    report(item_sealed, inline_d)
+                if item_sealed:
+                    fp_report(item_sealed, None)
+                count += 1
+                break
+            rid = tid.object_id_for_return(count + 1)
+            item_sealed = []
+            inline_d = _put_value(entry, rid, item, item_sealed)
+            if report is not None:
+                report(item_sealed, inline_d)
+            if item_sealed:
+                fp_report(item_sealed, None)
+            count += 1
+        header_inline = _put_value(
+            entry, tid.object_id_for_return(0), {STREAM_COUNT_KEY: count}, sealed
+        )
+        if header_inline:
+            entry["_inline"].update(header_inline)
+
     def store_returns(entry: dict, result: Any, sealed: List[str]) -> None:
+        if entry.get("streaming"):
+            _store_stream(entry, result, sealed)
+            return
         rids = [ObjectID.from_hex(h) for h in entry["return_ids"]]
         if len(rids) == 1:
             values = [result]
@@ -429,6 +532,15 @@ def main(argv: List[str]) -> None:
             ok = run_body(entry, sealed)
         report(entry, ok, sealed)
 
+    def _make_stream_report(send_raw):
+        def report(sealed: List[str], inline) -> None:
+            try:
+                send_raw(("si", sealed, inline))
+            except OSError:
+                pass  # consumer gone; items are in shm/dropped regardless
+
+        return report
+
     conn_senders: Dict[Any, Any] = {}
     lease_revoked = [False]  # sticky until the lease is returned: a revoke
     # can land before the owner's connect (worker-boot race) and must
@@ -467,7 +579,7 @@ def main(argv: List[str]) -> None:
                 if kind == "t":
                     # Leased normal task: the main thread executes it (keeps
                     # SIGINT cancellation + serial semantics).
-                    _, tid, fh, fb, ab, rids, desc = frame
+                    _, tid, fh, fb, ab, rids, desc, streaming = frame
                     entry = {
                         "type": "task",
                         "task_id": tid,
@@ -476,11 +588,14 @@ def main(argv: List[str]) -> None:
                         "args_blob": ab,
                         "return_ids": rids,
                         "desc": desc,
+                        "streaming": streaming,
                         "_inline": {},
                     }
+                    if streaming:
+                        entry["_stream_report"] = _make_stream_report(send_raw)
                     direct_inbox.put((entry, send_done))
                 elif kind == "a":
-                    _, tid, aid, method, ab, rids, desc = frame
+                    _, tid, aid, method, ab, rids, desc, streaming = frame
                     entry = {
                         "type": "actor_task",
                         "task_id": tid,
@@ -489,8 +604,11 @@ def main(argv: List[str]) -> None:
                         "args_blob": ab,
                         "return_ids": rids,
                         "desc": desc,
+                        "streaming": streaming,
                         "_inline": {},
                     }
+                    if streaming:
+                        entry["_stream_report"] = _make_stream_report(send_raw)
                     _exec_direct_actor(entry, send_done)
                 elif kind == "rv":
                     _dlog(f"revoke received; relaying to {len(conn_senders)} conns")

@@ -55,6 +55,90 @@ refresh(); setInterval(refresh, 2000);
 """
 
 
+def _prom_name(name: str) -> str:
+    import re
+
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def _prom_tags(tags: Dict[str, Any]) -> str:
+    if not tags:
+        return ""
+    def esc(v: Any) -> str:
+        # Prometheus label escaping: backslash, double-quote, newline.
+        return (
+            str(v)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+
+    inner = ",".join(
+        f'{_prom_name(str(k))}="{esc(v)}"' for k, v in sorted(tags.items())
+    )
+    return "{" + inner + "}"
+
+
+def prometheus_text(stats: dict, user_metrics: list) -> str:
+    """Prometheus text exposition of runtime + user metrics (reference:
+    _private/metrics_agent.py:483 — the OpenCensus->Prometheus exporter
+    every node agent runs; here one cluster-level scrape target)."""
+    lines = []
+
+    def emit(name, mtype, samples, help_text=""):
+        name = _prom_name(name)
+        if help_text:
+            lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for tags, val in samples:
+            lines.append(f"{name}{_prom_tags(tags)} {val}")
+
+    emit("ray_tpu_nodes_alive", "gauge", [({}, stats.get("nodes_alive", 0))],
+         "Alive raylet count")
+    emit("ray_tpu_tasks", "gauge",
+         [({"state": s}, c) for s, c in (stats.get("tasks") or {}).items()],
+         "Task-table entries by state")
+    emit("ray_tpu_actors", "gauge",
+         [({"state": s}, c) for s, c in (stats.get("actors") or {}).items()],
+         "Actors by state")
+    store = stats.get("store") or {}
+    emit("ray_tpu_object_store_bytes_in_use", "gauge",
+         [({}, store.get("bytes_in_use", 0))])
+    emit("ray_tpu_object_store_objects", "gauge",
+         [({}, store.get("num_objects", 0))])
+    emit("ray_tpu_objects_spilled", "gauge", [({}, store.get("num_spilled", 0))])
+    emit("ray_tpu_placement_groups", "gauge",
+         [({}, stats.get("placement_groups", 0))])
+
+    by_name: Dict[str, list] = {}
+    for m in user_metrics:
+        by_name.setdefault(m["name"], []).append(m)
+    for name, entries in sorted(by_name.items()):
+        kind = entries[0].get("kind")
+        if kind == "counter":
+            emit(name, "counter", [(e.get("tags") or {}, e.get("value", 0.0)) for e in entries])
+        elif kind == "gauge":
+            emit(name, "gauge", [(e.get("tags") or {}, e.get("value", 0.0)) for e in entries])
+        elif kind == "histogram":
+            pname = _prom_name(name)
+            lines.append(f"# TYPE {pname} histogram")
+            for e in entries:
+                tags = e.get("tags") or {}
+                bounds = e.get("boundaries") or []
+                counts = e.get("counts") or []
+                cum = 0
+                for b, c in zip(bounds, counts):
+                    cum += c
+                    lines.append(
+                        f"{pname}_bucket{_prom_tags({**tags, 'le': b})} {cum}"
+                    )
+                total = sum(counts)
+                lines.append(f"{pname}_bucket{_prom_tags({**tags, 'le': '+Inf'})} {total}")
+                lines.append(f"{pname}_sum{_prom_tags(tags)} {e.get('value', 0.0)}")
+                lines.append(f"{pname}_count{_prom_tags(tags)} {total}")
+    return "\n".join(lines) + "\n"
+
+
 class _Dashboard:
     def __init__(self, host: str = "127.0.0.1", port: int = 8265):
         import http.server
@@ -86,32 +170,105 @@ class _Dashboard:
                 return list_job_records(gcs)
             raise KeyError(path)
 
+        job_client_box: Dict[str, Any] = {}
+
+        def job_client():
+            # Lazy: the dashboard may outlive/predate job use entirely.
+            cli = job_client_box.get("cli")
+            if cli is None:
+                from .jobs import JobSubmissionClient
+
+                cli = JobSubmissionClient()
+                job_client_box["cli"] = cli
+            return cli
+
         class Handler(http.server.BaseHTTPRequestHandler):
             def log_message(self, *a):
                 pass
 
-            def do_GET(self):
-                if self.path in ("/", "/index.html"):
-                    body = _PAGE.encode()
-                    ctype = "text/html; charset=utf-8"
-                    code = 200
-                elif self.path.startswith("/api/"):
-                    try:
-                        body = json.dumps(collect(self.path[len("/api/"):]), default=str).encode()
-                        ctype = "application/json"
-                        code = 200
-                    except KeyError:
-                        body, ctype, code = b'{"error": "unknown endpoint"}', "application/json", 404
-                    except Exception as e:  # noqa: BLE001
-                        body = json.dumps({"error": repr(e)}).encode()
-                        ctype, code = "application/json", 500
-                else:
-                    body, ctype, code = b"not found", "text/plain", 404
+            def _reply(self, code, body, ctype="application/json"):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path in ("/", "/index.html"):
+                    self._reply(200, _PAGE.encode(), "text/html; charset=utf-8")
+                    return
+                if self.path == "/metrics":
+                    # Prometheus text exposition (reference:
+                    # metrics_agent.py:483 Prometheus exporter).
+                    try:
+                        text = prometheus_text(
+                            gcs.call("stats"), gcs.call("user_metrics")
+                        )
+                        self._reply(
+                            200, text.encode(), "text/plain; version=0.0.4"
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        self._reply(500, json.dumps({"error": repr(e)}).encode())
+                    return
+                if self.path.startswith("/api/jobs/"):
+                    # REST job API (reference: dashboard/modules/job/job_head.py)
+                    rest = self.path[len("/api/jobs/"):]
+                    try:
+                        if rest.endswith("/logs"):
+                            logs = job_client().get_job_logs(rest[: -len("/logs")])
+                            self._reply(200, json.dumps({"logs": logs}).encode())
+                        else:
+                            self._reply(
+                                200,
+                                json.dumps(
+                                    job_client().get_job_info(rest), default=str
+                                ).encode(),
+                            )
+                    except KeyError:
+                        self._reply(404, b'{"error": "no such job"}')
+                    except Exception as e:  # noqa: BLE001
+                        self._reply(500, json.dumps({"error": repr(e)}).encode())
+                    return
+                if self.path.startswith("/api/"):
+                    try:
+                        body = json.dumps(collect(self.path[len("/api/"):]), default=str).encode()
+                        self._reply(200, body)
+                    except KeyError:
+                        self._reply(404, b'{"error": "unknown endpoint"}')
+                    except Exception as e:  # noqa: BLE001
+                        self._reply(500, json.dumps({"error": repr(e)}).encode())
+                    return
+                self._reply(404, b"not found", "text/plain")
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b"{}"
+                try:
+                    payload = json.loads(raw or b"{}")
+                except Exception:
+                    self._reply(400, b'{"error": "bad json"}')
+                    return
+                try:
+                    if self.path == "/api/jobs":
+                        job_id = job_client().submit_job(
+                            entrypoint=payload["entrypoint"],
+                            runtime_env=payload.get("runtime_env"),
+                            job_id=payload.get("job_id"),
+                        )
+                        self._reply(200, json.dumps({"job_id": job_id}).encode())
+                        return
+                    if self.path.startswith("/api/jobs/") and self.path.endswith("/stop"):
+                        jid = self.path[len("/api/jobs/"):-len("/stop")]
+                        ok = job_client().stop_job(jid)
+                        self._reply(200, json.dumps({"stopped": ok}).encode())
+                        return
+                except KeyError as e:
+                    self._reply(400, json.dumps({"error": f"missing {e}"}).encode())
+                    return
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, json.dumps({"error": repr(e)}).encode())
+                    return
+                self._reply(404, b'{"error": "unknown endpoint"}')
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True

@@ -517,12 +517,26 @@ class Dataset:
         local_shuffle_buffer_size: Optional[int] = None,
         local_shuffle_seed: Optional[int] = None,
     ) -> Iterator[Any]:
-        """(reference: dataset.py:3844 via iterator.py)"""
+        """(reference: dataset.py:3844 via iterator.py).
+
+        `prefetch_batches` block fetches stay in flight ahead of the
+        consumer (futures over the object plane), overlapping task
+        execution/transfer with downstream consumption — the iterator
+        analogue of the reference's prefetching block batching
+        (_internal/block_batching)."""
+        import collections
+
         from .iterator import rebatch_blocks
 
         def block_iter():
+            ahead = max(0, int(prefetch_batches))
+            window: "collections.deque" = collections.deque()
             for ref in self.iter_block_refs():
-                yield api.get(ref)
+                window.append(ref.future())
+                while len(window) > ahead:
+                    yield window.popleft().result()
+            while window:
+                yield window.popleft().result()
 
         yield from rebatch_blocks(
             block_iter(),

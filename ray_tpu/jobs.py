@@ -93,8 +93,22 @@ class _JobSupervisor:
 
 
 class JobSubmissionClient:
-    """(reference: dashboard/modules/job/sdk.py JobSubmissionClient —
-    HTTP there, direct GCS/actor calls here.)"""
+    """(reference: dashboard/modules/job/sdk.py JobSubmissionClient).
+
+    Two transports, picked by the address:
+    - in-cluster (default / tcp:// / session path): supervisor actors
+      driven directly through the runtime;
+    - http(s):// — the dashboard's REST job API (reference:
+      dashboard/modules/job/job_head.py), for drivers OUTSIDE the
+      cluster: `JobSubmissionClient("http://head:8265")`.
+    """
+
+    def __new__(cls, address: Optional[str] = None):
+        if cls is JobSubmissionClient and isinstance(address, str) and address.startswith(
+            ("http://", "https://")
+        ):
+            return object.__new__(HttpJobSubmissionClient)
+        return object.__new__(cls)
 
     def __init__(self, address: Optional[str] = None):
         if address and not runtime_base.is_initialized():
@@ -166,6 +180,71 @@ class JobSubmissionClient:
             except exc.GetTimeoutError:
                 pass  # still running: report the current status
             return self.get_job_status(job_id)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self.get_job_status(job_id)
+            if st in ("SUCCEEDED", "FAILED", "STOPPED"):
+                return st
+            time.sleep(0.5)
+        return self.get_job_status(job_id)
+
+
+class HttpJobSubmissionClient(JobSubmissionClient):
+    """REST transport against the dashboard's job endpoints (reference:
+    dashboard/modules/job/sdk.py speaking to job_head.py)."""
+
+    def __init__(self, address: str):
+        self._base = address.rstrip("/")
+
+    def _request(self, method: str, path: str, payload: Optional[dict] = None):
+        import urllib.request
+
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            self._base + path,
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        runtime_env: Optional[dict] = None,
+        job_id: Optional[str] = None,
+    ) -> str:
+        return self._request(
+            "POST",
+            "/api/jobs",
+            {"entrypoint": entrypoint, "runtime_env": runtime_env, "job_id": job_id},
+        )["job_id"]
+
+    def get_job_info(self, job_id: str) -> Dict[str, Any]:
+        import urllib.error
+
+        try:
+            return self._request("GET", f"/api/jobs/{job_id}")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise KeyError(f"no such job {job_id!r}") from e
+            raise
+
+    def get_job_status(self, job_id: str) -> str:
+        return self.get_job_info(job_id)["status"]
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        return self._request("GET", "/api/jobs")
+
+    def get_job_logs(self, job_id: str) -> str:
+        return self._request("GET", f"/api/jobs/{job_id}/logs")["logs"]
+
+    def stop_job(self, job_id: str) -> bool:
+        return bool(self._request("POST", f"/api/jobs/{job_id}/stop")["stopped"])
+
+    def wait_until_finished(self, job_id: str, timeout: float = 300.0) -> str:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             st = self.get_job_status(job_id)

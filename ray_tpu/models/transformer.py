@@ -309,3 +309,67 @@ def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
     )
     attn = 12 * cfg.n_layers * cfg.d_model * (seq_len / 2)
     return 6.0 * n_params + attn
+
+
+def forward_pipelined(
+    params: PyTree,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh,
+    *,
+    num_microbatches: int = 4,
+    stage_axis: str = "stage",
+) -> jax.Array:
+    """Pipeline-parallel forward: the layer stack splits into S stages
+    over the mesh's `stage` axis, microbatches stream through a GPipe
+    schedule, and autodiff of THIS function is the backward pipeline
+    (parallel/pipeline.py; reference: the compiled-graph PP substrate,
+    dag/compiled_dag_node.py:664 — inverted into one SPMD program).
+    Embedding/head run replicated outside the pipeline (they are
+    batch-local); only the homogeneous block stack is staged."""
+    from ..parallel.pipeline import pipeline_apply, split_stacked_layers
+
+    S = dict(zip(mesh.axis_names, mesh.devices.shape))[stage_axis]
+    b, s = tokens.shape
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} not divisible into {num_microbatches} microbatches")
+    cos, sin = rope_tables(cfg, s)
+    x = jnp.take(params["embed"]["embedding"], tokens, axis=0)
+
+    stage_params = split_stacked_layers(params["blocks"], S)
+    mb = x.reshape(num_microbatches, b // num_microbatches, s, cfg.d_model)
+
+    def stage_fn(local_blocks, xin):
+        def step(h, layer_params):
+            return _layer(h, layer_params, cfg, cos, sin, None), None
+
+        out, _ = lax.scan(step, xin, local_blocks)
+        return out
+
+    y = pipeline_apply(stage_fn, stage_params, mb, mesh, axis=stage_axis, remat=cfg.remat)
+    x = y.reshape(b, s, cfg.d_model)
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"]["embedding"].T
+    return jnp.einsum("bsd,dv->bsv", x, head, preferred_element_type=jnp.float32)
+
+
+def next_token_loss_pipelined(
+    params: PyTree,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh,
+    *,
+    num_microbatches: int = 4,
+) -> jax.Array:
+    """Pipelined counterpart of next_token_loss (grad through it IS the
+    backward pipeline)."""
+    logits = forward_pipelined(
+        params, tokens, cfg, mesh, num_microbatches=num_microbatches
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    m = jnp.ones_like(nll).at[:, -1].set(0.0)
+    return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)

@@ -3,6 +3,7 @@ API stack, SURVEY.md §2g): RLModule (jax), EnvRunner (gymnasium),
 JaxLearner (jitted optax update, in-program psum instead of NCCL DDP),
 PPO and IMPALA."""
 
+from .appo import APPO, APPOConfig, appo_loss
 from .dqn import DQN, DQNConfig, QModule, dqn_loss
 from .env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 from .impala import IMPALA, IMPALAConfig, impala_loss, vtrace
@@ -46,6 +47,7 @@ from .offline import rollouts_to_transitions
 from .sac import SAC, SACConfig, SquashedGaussianModule
 
 __all__ = [
+    "APPO", "APPOConfig", "appo_loss",
     "EnvRunnerGroup", "SingleAgentEnvRunner", "IMPALA", "IMPALAConfig",
     "impala_loss", "vtrace", "JaxLearner", "LearnerGroup",
     "DiscretePolicyConfig", "DiscretePolicyModule", "RLModule",

@@ -132,16 +132,9 @@ class IMPALA:
     """(reference: impala.py:607 training_step; async sample pipeline)"""
 
     def __init__(self, config: IMPALAConfig):
-        import functools
-
         self.config = config
         self.module = build_discrete_module(config.env, config.hidden)
-        loss = functools.partial(
-            impala_loss,
-            gamma=config.gamma,
-            vf_coeff=config.vf_coeff,
-            ent_coeff=config.entropy_coeff,
-        )
+        loss = self._make_loss(config)
         self.learner_group = LearnerGroup(
             self.module, loss, lr=config.lr, grad_clip=config.grad_clip, seed=config.seed
         )
@@ -163,6 +156,17 @@ class IMPALA:
             r.sample.remote(config.rollout_length): r
             for r in self.env_runner_group.runners
         }
+
+    def _make_loss(self, config):
+        """Loss factory — APPO overrides with the clipped surrogate."""
+        import functools
+
+        return functools.partial(
+            impala_loss,
+            gamma=config.gamma,
+            vf_coeff=config.vf_coeff,
+            ent_coeff=config.entropy_coeff,
+        )
 
     def train(self) -> Dict[str, Any]:
         """Consume the first finished rollout, update, re-issue the request

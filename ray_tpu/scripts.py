@@ -173,10 +173,15 @@ def cmd_status(args) -> None:
 def cmd_submit(args) -> None:
     import shlex
 
-    _connect(args)
     from .jobs import JobSubmissionClient
 
-    client = JobSubmissionClient()
+    if args.address and args.address.startswith(("http://", "https://")):
+        # Remote submission over the dashboard's REST job API — no cluster
+        # attach needed (reference: `ray job submit --address http://...`).
+        client = JobSubmissionClient(args.address)
+    else:
+        _connect(args)
+        client = JobSubmissionClient()
     parts = list(args.entrypoint)
     if parts and parts[0] == "--":  # argparse.REMAINDER keeps the separator
         parts = parts[1:]

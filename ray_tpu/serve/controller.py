@@ -129,6 +129,48 @@ class Replica:
                 with self._lock:
                     self._ongoing -= 1
 
+    def handle_request_stream(self, method: str, args, kwargs, context=None):
+        """Streaming request path: runs as a num_returns="streaming" actor
+        task, so each yielded chunk ships to the caller as produced via
+        the core streaming-generator protocol (reference: serve
+        replica.py handle_request_streaming — here layered directly on the
+        runtime primitive instead of a bespoke pull protocol)."""
+        import asyncio
+        import inspect
+
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            from .batching import set_request_context
+
+            set_request_context(
+                multiplexed_model_id=(context or {}).get("multiplexed_model_id", "")
+            )
+            fn = self._callable if method == "__call__" else getattr(self._callable, method)
+            if method == "__call__" and not callable(self._callable):
+                raise TypeError("deployment target is not callable")
+            out = fn(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                out = asyncio.run(out)
+            if inspect.isasyncgen(out):
+                loop = asyncio.new_event_loop()
+                try:
+                    while True:
+                        try:
+                            yield loop.run_until_complete(out.__anext__())
+                        except StopAsyncIteration:
+                            break
+                finally:
+                    loop.close()
+            elif inspect.isgenerator(out):
+                yield from out
+            else:
+                yield out  # non-generator handler: a one-chunk stream
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
     def next_chunks(self, stream_id: str, max_n: int = 8, timeout: float = 2.0):
         """Pulls up to max_n chunks; returns (chunks, done). Short blocking
         window so slow streams don't pin replica concurrency slots — the

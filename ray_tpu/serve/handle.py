@@ -96,24 +96,41 @@ class DeploymentResponse:
 
 
 class DeploymentResponseGenerator:
-    """Iterates a streaming deployment response chunk-by-chunk (reference:
-    serve/handle.py DeploymentResponseGenerator over the streaming
-    generator protocol)."""
+    """Iterates a streaming deployment response chunk-by-chunk.
 
-    def __init__(self, response: DeploymentResponse):
-        self._response = response
+    Rides the CORE streaming-generator primitive: the replica method runs
+    as a `num_returns="streaming"` actor task, each yielded chunk becomes
+    a return object delivered as produced, and this wrapper resolves them
+    to values (reference: serve/handle.py DeploymentResponseGenerator over
+    the streaming generator protocol of _raylet.pyx:281 — here the same
+    layering, serve on top of core streaming)."""
+
+    def __init__(self, ref_gen, on_done):
+        self._gen = ref_gen
+        self._on_done = on_done
+        self._finished = False
+
+    def _finish(self):
+        if not self._finished:
+            self._finished = True
+            self._on_done()
 
     def __iter__(self):
+        return self
+
+    def __next__(self):
         # The outstanding counter holds until the stream is drained, so
         # pow-2 routing sees long-lived streams as load.
         try:
-            out = api.get(self._response._ref, timeout=60)
-            if isinstance(out, dict) and _STREAM_MARKER in out:
-                yield from self._response._iter_stream(out[_STREAM_MARKER])
-            else:
-                yield out  # non-generator handler: a one-chunk stream
-        finally:
-            self._response._finish()
+            ref = next(self._gen)
+        except BaseException:
+            self._finish()
+            raise
+        try:
+            return api.get(ref)
+        except BaseException:
+            self._finish()
+            raise
 
 
 class DeploymentHandle:
@@ -201,11 +218,13 @@ class DeploymentHandle:
         context = (
             {"multiplexed_model_id": self._mux_id} if self._mux_id is not None else None
         )
-        ref = replica.handle_request.remote(self._method, args, kwargs, context)
-        response = DeploymentResponse(ref, done, replica=replica)
         if self._stream:
-            return DeploymentResponseGenerator(response)
-        return response
+            ref_gen = replica.handle_request_stream.options(
+                num_returns="streaming"
+            ).remote(self._method, args, kwargs, context)
+            return DeploymentResponseGenerator(ref_gen, done)
+        ref = replica.handle_request.remote(self._method, args, kwargs, context)
+        return DeploymentResponse(ref, done, replica=replica)
 
 
 # ------------------------------------------------------------------ proxy

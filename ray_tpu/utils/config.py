@@ -62,6 +62,12 @@ class RayTpuConfig:
     # Chunk size for node-to-node object transfer (reference:
     # object_manager_default_chunk_size).
     transfer_chunk_bytes: int = _declare("transfer_chunk_bytes", 8 << 20)
+    # Admission control on the object plane (reference: pull_manager.h:52
+    # bounded pulls + push_manager chunk scheduling): max concurrent
+    # inbound pulls per node, and max concurrent outbound chunk streams a
+    # node will serve before requesters queue.
+    max_concurrent_pulls: int = _declare("max_concurrent_pulls", 4)
+    max_concurrent_serves: int = _declare("max_concurrent_serves", 4)
     # Pool-usage fraction above which the raylet spills sealed objects.
     spill_threshold: float = _declare("spill_threshold", 0.8)
 

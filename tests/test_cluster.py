@@ -393,3 +393,36 @@ def test_runtime_context_task_ids(cluster_rt):
     # Driver-side context: node id known, no task.
     c = rt.get_runtime_context()
     assert c.get_node_id() and c.get_task_id() is None
+
+
+def test_broadcast_tree_replicates_to_all_nodes():
+    """ray_tpu.broadcast: binary push tree replicates one object to every
+    node; all nodes then read it locally (reference: push_manager.h:30 —
+    the weight-sync fan-out path)."""
+    import time
+
+    import numpy as np
+
+    import ray_tpu as rtpu
+    from ray_tpu.core.cluster_runtime import Cluster
+
+    rtpu.shutdown()
+    cluster = Cluster(num_cpus=2, num_workers=1, object_store_memory=128 << 20)
+    node_ids = [cluster.add_node(num_cpus=1, num_workers=0) for _ in range(3)]
+    rt = cluster.runtime()
+    from ray_tpu.core import runtime_base
+
+    runtime_base.set_runtime(rt)
+    try:
+        import ray_tpu as r
+
+        payload = np.arange(2_000_000, dtype=np.float64)  # 16 MB
+        ref = r.put(payload)
+        n = r.broadcast(ref, timeout=60)
+        assert n == 3
+        # Every node's raylet now holds a replica.
+        locs = rt._gcs.call("get_object_locations", ref.hex())
+        assert len(locs) == 4, locs
+    finally:
+        rt.shutdown()
+        cluster.shutdown()

@@ -211,3 +211,88 @@ def test_actor_max_concurrency(rt_local):
     t0 = time.monotonic()
     rt.get([p.slow.remote() for _ in range(4)])
     assert time.monotonic() - t0 < 0.7  # ran concurrently
+
+
+class TestStreamingReturns:
+    """num_returns="streaming" generator tasks (reference:
+    python/ray/_raylet.pyx:281 ObjectRefGenerator)."""
+
+    def test_task_stream(self, rt_cluster):
+        rt = rt_cluster
+
+        @rt.remote(num_returns="streaming")
+        def gen(n):
+            for i in range(n):
+                yield i * 10
+
+        assert [rt.get(r) for r in gen.remote(5)] == [0, 10, 20, 30, 40]
+
+    def test_empty_stream(self, rt_cluster):
+        rt = rt_cluster
+
+        @rt.remote(num_returns="streaming")
+        def empty():
+            return
+            yield  # pragma: no cover
+
+        assert list(empty.remote()) == []
+
+    def test_mid_stream_error_surfaces_at_index(self, rt_cluster):
+        import pytest as _pytest
+
+        rt = rt_cluster
+
+        @rt.remote(num_returns="streaming")
+        def bad():
+            yield 1
+            raise ValueError("boom")
+
+        it = iter(bad.remote())
+        assert rt.get(next(it)) == 1
+        with _pytest.raises(Exception, match="boom"):
+            rt.get(next(it))
+
+    def test_actor_stream(self, rt_cluster):
+        rt = rt_cluster
+
+        @rt.remote
+        class A:
+            def stream(self, n):
+                for i in range(n):
+                    yield i + 100
+
+        a = A.remote()
+        g = a.stream.options(num_returns="streaming").remote(3)
+        assert [rt.get(r) for r in g] == [100, 101, 102]
+
+    def test_stream_is_incremental(self, rt_cluster):
+        import time as _time
+
+        rt = rt_cluster
+
+        @rt.remote(num_returns="streaming")
+        def slow():
+            for i in range(3):
+                _time.sleep(0.4)
+                yield i
+
+        t0 = _time.monotonic()
+        it = iter(slow.remote())
+        rt.get(next(it))
+        t_first = _time.monotonic() - t0
+        list(it)
+        t_all = _time.monotonic() - t0
+        assert t_first < t_all - 0.3, (t_first, t_all)
+
+    def test_large_items_via_store(self, rt_cluster):
+        import numpy as np
+
+        rt = rt_cluster
+
+        @rt.remote(num_returns="streaming")
+        def big(n):
+            for i in range(n):
+                yield np.full(300_000, i, dtype=np.float64)  # > inline cap
+
+        vals = [rt.get(r) for r in big.remote(3)]
+        assert [float(v[0]) for v in vals] == [0.0, 1.0, 2.0]

@@ -137,3 +137,54 @@ def test_cli_start_status_submit_stop(tmp_path):
     finally:
         stop = cli("stop")
         assert stop.returncode == 0, stop.stderr
+
+
+def test_autoscaler_provisions_slice_for_pending_gang(cluster):
+    """e2e: a SLICE_GANG placement group that no node can host goes
+    PENDING; the autoscaler provisions a whole fake slice (atomic,
+    labeled) and the gang schedules onto it (reference:
+    fake_multi_node/node_provider.py:236 e2e pattern + the TPU
+    slice-atomic provisioning SURVEY §5 autoscaler calls for)."""
+    import ray_tpu as rt
+    from ray_tpu.autoscaler import Autoscaler, LocalTPUSliceProvider
+    from ray_tpu.core.placement_group import (
+        PlacementGroupSchedulingStrategy,
+        placement_group,
+        remove_placement_group,
+    )
+
+    cluster_obj, rtc = cluster
+    pg = placement_group([{"TPU": 4, "CPU": 1}] * 2, strategy="SLICE_GANG")
+    assert not pg.ready(timeout=1.0)  # no TPU hosts exist: stays PENDING
+
+    scaler = Autoscaler(
+        LocalTPUSliceProvider(cluster_obj),
+        max_nodes=8,
+        upscale_delay_s=0.5,
+        interval_s=0.5,
+    )
+    scaler.start()
+    try:
+        assert pg.ready(timeout=120), "gang never scheduled after scale-up"
+        assert scaler.num_upscales >= 1
+        nodes = set(pg.bundle_placements.values())
+        assert len(nodes) == 2  # one bundle per slice host
+
+        @rt.remote(num_cpus=1)
+        def where():
+            from ray_tpu.core import runtime_base
+
+            return runtime_base.current_runtime().node_id()
+
+        got = rt.get(
+            where.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=0
+                )
+            ).remote(),
+            timeout=90,
+        )
+        assert got == pg.bundle_placements[0]
+    finally:
+        scaler.stop()
+        remove_placement_group(pg)

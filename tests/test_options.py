@@ -143,7 +143,11 @@ def test_runtime_env_working_dir(rt_cluster, tmp_path):
 
     value, cwd = rt.get(use_module.remote(), timeout=30)
     assert value == "from-working-dir"
-    assert cwd == str(tmp_path)
+    # working_dir ships as a content-addressed package and extracts into
+    # the node cache — the worker runs in the EXTRACTED copy, not the
+    # driver's original path (reference: working_dir URIs, packaging.py).
+    assert cwd != str(tmp_path)
+    assert os.path.exists(os.path.join(cwd, "wd_module.py"))
 
 
 def test_runtime_env_actor(rt_cluster):
@@ -157,7 +161,7 @@ def test_runtime_env_actor(rt_cluster):
 
 
 def test_runtime_env_unsupported_field_raises(rt_cluster):
-    @rt.remote(runtime_env={"pip": ["requests"]})
+    @rt.remote(runtime_env={"conda": {"dependencies": ["requests"]}})
     def f():
         return 1
 

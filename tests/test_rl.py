@@ -622,3 +622,21 @@ def test_cql_is_conservative_on_ood_actions(rt):
 
     acts = algo.compute_actions(eval_obs[:4])
     assert acts.shape == (4, act_dim) and np.all(np.abs(acts) <= 1.0)
+
+
+def test_appo_cartpole_runs_and_improves(rt):
+    """APPO: async PPO on the IMPALA pipeline (reference: appo.py:278)."""
+    from ray_tpu.rl import APPOConfig
+
+    algo = APPOConfig(
+        env="CartPole-v1", num_env_runners=2, num_envs_per_runner=4
+    ).build()
+    best = 0.0
+    for _ in range(60):
+        result = algo.train()
+        r = result.get("episode_return_mean")
+        if r is not None and r == r:
+            best = max(best, r)
+        if best >= 60:
+            break
+    assert best >= 60, f"APPO showed no learning signal: best={best}"

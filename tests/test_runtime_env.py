@@ -1,0 +1,102 @@
+"""Runtime-env dependency management (reference: the reference's
+_private/runtime_env/ pip.py venv plugin, packaging.py GCS packages,
+uri_cache.py GC — SURVEY.md §5 runtime envs)."""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+
+@pytest.fixture
+def rt():
+    import ray_tpu as rtpu
+
+    rtpu.shutdown()
+    rtpu.init(num_cpus=2, num_workers=1)
+    yield rtpu
+    rtpu.shutdown()
+
+
+def test_working_dir_packaged_through_gcs(rt, tmp_path):
+    """working_dir ships as a content-addressed GCS package, not a path:
+    the worker extracts it into its node cache and chdirs there."""
+    (tmp_path / "data.txt").write_text("hello from package")
+
+    @rt.remote(runtime_env={"working_dir": str(tmp_path)})
+    def read_data():
+        with open("data.txt") as f:
+            return f.read()
+
+    assert rt.get(read_data.remote(), timeout=60) == "hello from package"
+
+
+def test_py_modules(rt, tmp_path):
+    """py_modules: a local module directory becomes importable in the
+    worker without being installed on the driver's sys.path."""
+    mod = tmp_path / "rtpu_testmod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("VALUE = 42\n")
+
+    @rt.remote(runtime_env={"py_modules": [str(mod)]})
+    def use_mod():
+        import rtpu_testmod
+
+        return rtpu_testmod.VALUE
+
+    assert rt.get(use_mod.remote(), timeout=60) == 42
+    with pytest.raises(ImportError):
+        import rtpu_testmod  # noqa: F401 — must NOT leak into the driver
+
+
+def test_pip_venv_isolated_package(rt, tmp_path):
+    """pip: the worker runs inside a per-env virtualenv with the requested
+    package installed (offline: a local source package; system
+    site-packages stay visible so jax/numpy keep working)."""
+    pkg = tmp_path / "rtpu_pippkg"
+    (pkg / "rtpu_pippkg").mkdir(parents=True)
+    (pkg / "rtpu_pippkg" / "__init__.py").write_text("MAGIC = 'venv-ok'\n")
+    (pkg / "setup.py").write_text(
+        textwrap.dedent(
+            """
+            from setuptools import setup, find_packages
+            setup(name="rtpu-pippkg", version="0.1", packages=find_packages())
+            """
+        )
+    )
+
+    @rt.remote(
+        runtime_env={"pip": ["--no-build-isolation", str(pkg)]}
+    )
+    def use_pkg():
+        import rtpu_pippkg
+
+        return rtpu_pippkg.MAGIC, sys.prefix != sys.base_prefix  # in a venv
+
+    magic, in_venv = rt.get(use_pkg.remote(), timeout=300)
+    assert magic == "venv-ok"
+    assert in_venv, "worker did not run inside the virtualenv"
+
+
+def test_env_vars_still_apply_with_packages(rt, tmp_path):
+    @rt.remote(runtime_env={"env_vars": {"RTPU_TEST_FLAG": "on"}})
+    def read_env():
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    assert rt.get(read_env.remote(), timeout=60) == "on"
+
+
+def test_cache_gc(tmp_path):
+    from ray_tpu.core import runtime_env as re_mod
+
+    pkgs = tmp_path / "pkgs"
+    pkgs.mkdir()
+    for i in range(re_mod.MAX_CACHED_PACKAGES + 4):
+        d = pkgs / f"digest{i:02d}"
+        d.mkdir()
+        os.utime(d, (i, i))  # older mtime = lower i
+    re_mod.gc_cache(str(tmp_path))
+    left = sorted(os.listdir(pkgs))
+    assert len(left) == re_mod.MAX_CACHED_PACKAGES
+    assert "digest00" not in left  # oldest evicted

@@ -131,7 +131,15 @@ def test_gang_infeasible_without_slices():
     rt.shutdown()
     rt.init(num_cpus=4)  # no slice-labelled nodes at all
     try:
-        with pytest.raises(Exception, match="slice"):
-            placement_group([{"CPU": 1.0}], strategy="SLICE_GANG")
+        # Creation is ASYNC (reference: gcs_placement_group_manager PENDING
+        # state): an unplaceable gang registers as PENDING — the autoscaler
+        # provisions slices for it (test_ops_layer slice e2e) — and ready()
+        # stays False until then.
+        pg = placement_group([{"CPU": 1.0}], strategy="SLICE_GANG")
+        assert not pg.ready(timeout=2.0)
+        from ray_tpu.core.runtime_base import current_runtime
+
+        info = current_runtime().placement_group_table()[pg.id_hex]
+        assert info["state"] == "PENDING"
     finally:
         rt.shutdown()

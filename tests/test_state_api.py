@@ -200,3 +200,69 @@ def test_user_metrics_counter_gauge_histogram(rt_cluster):
     assert sum(hist["counts"]) == 3 and hist["counts"][1] == 3  # all in (0.1, 1.0]
     gauge = found[("app_depth", ())]
     assert gauge["kind"] == "gauge" and gauge["value"] >= 0.0
+
+
+def test_prometheus_text_format_unit():
+    """Prometheus exposition of runtime + user metrics (reference:
+    _private/metrics_agent.py:483 exporter)."""
+    from ray_tpu.dashboard import prometheus_text
+
+    stats = {
+        "nodes_alive": 2,
+        "tasks": {"FINISHED": 5, "RUNNING": 1},
+        "actors": {"ALIVE": 3},
+        "store": {"bytes_in_use": 1024, "num_objects": 7, "num_spilled": 0},
+        "placement_groups": 1,
+    }
+    user = [
+        {"name": "my_counter", "kind": "counter", "tags": {"app": "x"}, "value": 9.0},
+        {"name": "my_gauge", "kind": "gauge", "tags": {}, "value": 2.5},
+        {
+            "name": "lat_ms", "kind": "histogram", "tags": {},
+            "value": 30.0, "counts": [2, 1], "boundaries": [10, 100],
+        },
+    ]
+    text = prometheus_text(stats, user)
+    assert "# TYPE ray_tpu_nodes_alive gauge" in text
+    assert 'ray_tpu_tasks{state="FINISHED"} 5' in text
+    assert 'my_counter{app="x"} 9.0' in text
+    assert "# TYPE my_counter counter" in text
+    assert 'lat_ms_bucket{le="10"} 2' in text
+    assert 'lat_ms_bucket{le="+Inf"} 3' in text
+    assert "lat_ms_count 3" in text
+
+
+def test_metrics_endpoint_and_rest_jobs(rt_cluster):
+    """/metrics serves Prometheus text; the REST job API submits, reports,
+    logs, and the HTTP JobSubmissionClient drives it end to end
+    (reference: dashboard job_head.py + sdk.py over HTTP)."""
+    import json
+    import sys
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+    from ray_tpu.jobs import HttpJobSubmissionClient, JobSubmissionClient
+
+    port = start_dashboard(port=0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        text = urllib.request.urlopen(base + "/metrics", timeout=30).read().decode()
+        assert "# TYPE ray_tpu_nodes_alive gauge" in text
+        assert "ray_tpu_nodes_alive 1" in text
+
+        client = JobSubmissionClient(base)
+        assert isinstance(client, HttpJobSubmissionClient)
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c \"print('rest-job-ok')\""
+        )
+        status = client.wait_until_finished(job_id, timeout=120)
+        assert status == "SUCCEEDED"
+        assert "rest-job-ok" in client.get_job_logs(job_id)
+        assert any(j["job_id"] == job_id for j in client.list_jobs())
+        # Plain curl-style GET of job info.
+        info = json.loads(
+            urllib.request.urlopen(f"{base}/api/jobs/{job_id}", timeout=30).read()
+        )
+        assert info["status"] == "SUCCEEDED"
+    finally:
+        stop_dashboard()
