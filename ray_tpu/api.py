@@ -136,9 +136,19 @@ def shutdown():
 
 
 def _process_args(args, kwargs):
-    """ObjectRefs in args become ArgRef dependencies resolved executor-side."""
+    """ObjectRefs in args become ArgRef dependencies resolved executor-side.
+
+    Passing a ref as an arg ESCAPES it: the executor (another process)
+    must be able to fetch the value, so inline results promote to shm and
+    the owner defers eager frees (same contract as serializing the ref,
+    object_ref.__reduce__ — which this path bypasses by translating to
+    ArgRef directly)."""
     def conv(a):
-        return ArgRef(a.id()) if isinstance(a, ObjectRef) else a
+        if isinstance(a, ObjectRef):
+            if a._runtime is not None:
+                a._runtime.mark_escaped(a._id)
+            return ArgRef(a.id())
+        return a
 
     return tuple(conv(a) for a in args), {k: conv(v) for k, v in (kwargs or {}).items()}
 

@@ -167,6 +167,12 @@ class ClusterRuntime(Runtime):
         # batched raylet/GCS notification lands.
         self._fast_pending: set = set()
         self._fast_seal_cv = threading.Condition()
+        # Oids a local get()/wait() is CURRENTLY blocked on: acks notify
+        # the cv only when they deliver one of these. Unconditional
+        # notify_all at ack rate (10k+/s) would wake the consumer once per
+        # completion — on a single shared core that context-switch storm
+        # throttles the producer pipeline ~20x.
+        self._fast_waiting: set = set()
         # Owner memory store: small direct-task results live here, never
         # touching shm or the GCS directory (reference: the CoreWorker
         # in-memory store, src/ray/core_worker/store_provider/memory_store/).
@@ -204,31 +210,47 @@ class ClusterRuntime(Runtime):
         if inline:
             memstore = self._memstore
             for h, blob in inline.items():
+                to_shm = False
                 with self._ref_lock:
-                    wanted = h in self._owned
-                if not wanted:
-                    wanted = h[:24] in self._stream_tasks  # stream item
-                if not wanted:
-                    # Every ref was dropped while the task was in flight
-                    # (fire-and-forget): storing the late result would leak
-                    # it forever — nothing will ever free this hex again.
-                    continue
-                if self._memstore_bytes + len(blob) > 256 << 20:
-                    # Memory-store cap: overflow objects go to shm where
-                    # the normal eviction/spill machinery owns them.
+                    # Escape-check and memstore insert under ONE lock hold:
+                    # mark_escaped (also under _ref_lock) either sees the
+                    # blob already in the memstore and promotes it, or adds
+                    # h to _escaped first and this branch routes to shm —
+                    # no interleaving can strand an escaped result in the
+                    # owner-only memstore.
+                    wanted = h in self._owned or h[:24] in self._stream_tasks
+                    if not wanted:
+                        # Every ref was dropped while the task was in
+                        # flight (fire-and-forget): storing the late result
+                        # would leak it forever.
+                        continue
+                    if (
+                        h in self._escaped
+                        or self._memstore_bytes + len(blob) > 256 << 20
+                    ):
+                        # Escaped (another process may need it) or over the
+                        # memstore cap: materialize to shm + directory.
+                        to_shm = True
+                    else:
+                        memstore[h] = blob
+                        self._memstore_bytes += len(blob)
+                if to_shm:
                     try:
                         self._store.put_raw(ObjectID.from_hex(h), blob)
                         self._raylet.notify("notify_object", h)
-                        continue
                     except Exception:
-                        pass
-                memstore[h] = blob
-                self._memstore_bytes += len(blob)
+                        memstore[h] = blob  # last resort: gets still work
+                        self._memstore_bytes += len(blob)
         with self._fast_seal_cv:
             self._fast_pending.difference_update(sealed)
             if inline:
                 self._fast_pending.difference_update(inline.keys())
-            self._fast_seal_cv.notify_all()
+            waiting = self._fast_waiting
+            if waiting and (
+                any(h in waiting for h in sealed)
+                or (inline and any(h in waiting for h in inline))
+            ):
+                self._fast_seal_cv.notify_all()
 
     def _stream_logs(self) -> None:
         log_dir = os.path.join(self._log_session, "logs")
@@ -609,9 +631,19 @@ class ClusterRuntime(Runtime):
                 if now < fast_until:
                     with self._fast_seal_cv:
                         if h in self._fast_pending:
-                            self._fast_seal_cv.wait(timeout=0.05)
+                            self._fast_waiting.add(h)
+                            try:
+                                self._fast_seal_cv.wait(timeout=0.05)
+                            finally:
+                                self._fast_waiting.discard(h)
                     continue
             fast_until = None
+            if h in self._memstore or self._store.contains(oid):
+                # The ack landed between the checks at the loop top and
+                # here (fast path completions are concurrent): re-check
+                # before committing to a multi-second raylet wait that can
+                # never see an inline-only object.
+                continue
             poll = CONFIG.object_wait_poll_s
             if remaining is not None:
                 poll = max(0.05, min(poll, remaining))
@@ -646,7 +678,11 @@ class ClusterRuntime(Runtime):
             ) < num_returns:
                 # Direct tasks in flight: wait on the ack wakeup first.
                 with self._fast_seal_cv:
-                    self._fast_seal_cv.wait(timeout=0.05)
+                    self._fast_waiting.update(pending_fast)
+                    try:
+                        self._fast_seal_cv.wait(timeout=0.05)
+                    finally:
+                        self._fast_waiting.difference_update(pending_fast)
                 if deadline is not None and time.monotonic() >= deadline:
                     ready_h = mem_ready | {
                         h for h in hexes if self._store.contains(ObjectID.from_hex(h))

@@ -65,8 +65,19 @@ class GcsService:
         self._raylet_clients: Dict[str, Any] = {}
         self._user_metrics: Dict[Tuple, dict] = {}
         self._stop = threading.Event()
+        # Write-ahead delta log between snapshots (reference: the Redis
+        # store client persists control-table mutations as they happen,
+        # redis_store_client.h:106; here an append-only file of
+        # (table, key, record) deltas replayed over the last snapshot).
+        # High-rate data-plane state (object locations, task events) stays
+        # snapshot-only — as in the reference, where the object directory
+        # is owner-based and rebuilt, not persisted.
+        self._wal_path = snapshot_path + ".wal" if snapshot_path else None
+        self._wal_f = None
         if snapshot_path:
             self._load_snapshot()
+            self._replay_wal()
+            self._wal_f = open(self._wal_path, "ab")
         self._health = threading.Thread(target=self._health_loop, daemon=True)
         self._health.start()
 
@@ -110,6 +121,51 @@ class GcsService:
                 if pg.get("state") == "REPLANNING":
                     pg["state"] = "RESCHEDULING"
 
+    _WAL_TABLES = ("_nodes", "_actors", "_named", "_pgs", "_kv")
+
+    def _persist_delta(self, table: str, key, value) -> None:
+        """Appends one control-table delta to the WAL (value=None deletes).
+        Called with self._lock held by the mutating handler, so snapshot
+        truncation (also under the lock) can never lose a record."""
+        if self._wal_f is None:
+            return
+        import copy
+        import pickle
+
+        try:
+            rec = pickle.dumps((table, key, copy.copy(value)))
+            self._wal_f.write(len(rec).to_bytes(4, "little") + rec)
+            self._wal_f.flush()
+        except Exception:
+            pass  # durability is best-effort between snapshots
+
+    def _replay_wal(self) -> None:
+        import pickle
+
+        try:
+            with open(self._wal_path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return
+        pos = 0
+        with self._lock:
+            while pos + 4 <= len(data):
+                n = int.from_bytes(data[pos:pos + 4], "little")
+                pos += 4
+                if pos + n > len(data):
+                    break  # torn tail write: ignore
+                try:
+                    table, key, value = pickle.loads(data[pos:pos + n])
+                except Exception:
+                    break
+                pos += n
+                if table in self._WAL_TABLES:
+                    d = getattr(self, table)
+                    if value is None:
+                        d.pop(key, None)
+                    else:
+                        d[key] = value
+
     def _save_snapshot(self) -> None:
         if not self._snapshot_path:
             return
@@ -122,10 +178,21 @@ class GcsService:
             data = {
                 name: copy.copy(getattr(self, name)) for name in self._PERSISTED
             }
+            # Remember how much of the WAL this snapshot covers; rotation
+            # happens only AFTER the snapshot is durably on disk (wiping
+            # first would lose every delta if the pickle/write fails or
+            # the process dies in between).
+            wal_covered = 0
+            if self._wal_f is not None:
+                try:
+                    self._wal_f.flush()
+                    wal_covered = self._wal_f.tell()
+                except Exception:
+                    wal_covered = 0
         try:
             blob = pickle.dumps(data)
         except Exception:
-            return
+            return  # WAL still intact: nothing lost
         tmp = self._snapshot_path + ".tmp"
         try:
             with open(tmp, "wb") as f:
@@ -134,7 +201,27 @@ class GcsService:
 
             os.replace(tmp, self._snapshot_path)
         except OSError:
-            pass  # retried next interval
+            return  # retried next interval; WAL still intact
+        if wal_covered:
+            with self._lock:
+                if self._wal_f is None:
+                    return
+                try:
+                    # Rotate: keep only deltas appended AFTER the copy
+                    # (they are not in the snapshot).
+                    self._wal_f.flush()
+                    with open(self._wal_path, "rb") as rf:
+                        rf.seek(wal_covered)
+                        suffix = rf.read()
+                    self._wal_f.close()
+                    with open(self._wal_path, "wb") as wf:
+                        wf.write(suffix)
+                    self._wal_f = open(self._wal_path, "ab")
+                except Exception:
+                    try:  # never leave the WAL handle closed
+                        self._wal_f = open(self._wal_path, "ab")
+                    except Exception:
+                        self._wal_f = None
 
     # ------------------------------------------------------------- nodes
     def register_node(
@@ -155,6 +242,7 @@ class GcsService:
                 "alive": True,
                 "last_hb": time.monotonic(),
             }
+            self._persist_delta("_nodes", node_id, self._nodes[node_id])
             n_alive = sum(1 for n in self._nodes.values() if n["alive"])
             retry_gangs = [
                 pg_id
@@ -189,6 +277,7 @@ class GcsService:
             n = self._nodes.get(node_id)
             if n:
                 n["alive"] = False
+                self._persist_delta("_nodes", node_id, n)
         self._on_node_death(node_id)
         return True
 
@@ -467,6 +556,7 @@ class GcsService:
         key = (a.get("namespace") or "default", a.get("name") or "")
         if a.get("name") and self._named.get(key) == actor_id:
             del self._named[key]
+            self._persist_delta("_named", key, None)
 
     def _place_with_strategy(self, resources: dict, strategy: str) -> Optional[dict]:
         """Strategy-aware node choice shared by first placement AND restart
@@ -576,6 +666,9 @@ class GcsService:
                 "namespace": namespace or "default",
                 "death_reason": "",
             }
+            self._persist_delta("_actors", actor_id, self._actors[actor_id])
+            if key is not None:
+                self._persist_delta("_named", key, actor_id)
         return node
 
     def actor_started(self, actor_id: str, node_id: str) -> bool:
@@ -584,6 +677,7 @@ class GcsService:
             if a:
                 a["state"] = "ALIVE"
                 a["node_id"] = node_id
+                self._persist_delta("_actors", actor_id, a)
         return True
 
     def actor_died(self, actor_id: str, reason: str, no_restart: bool = False) -> dict:
@@ -598,9 +692,11 @@ class GcsService:
                 a["death_reason"] = reason
                 a["node_id"] = None
                 self._drop_name(actor_id)
+                self._persist_delta("_actors", actor_id, a)
                 return {"restart": False}
             a["num_restarts"] += 1
             a["state"] = "RESTARTING"
+            self._persist_delta("_actors", actor_id, a)
             resources = dict(a["resources"])
             pg_id = a.get("pg_id")
             bundle_index = a.get("bundle_index", -1)
@@ -622,8 +718,10 @@ class GcsService:
                     else f"{reason}; no node for restart"
                 )
                 self._drop_name(actor_id)
+                self._persist_delta("_actors", actor_id, a)
                 return {"restart": False}
             a["node_id"] = node["node_id"]
+            self._persist_delta("_actors", actor_id, a)
             return {"restart": True, "node": node, "spec_blob": a["spec_blob"],
                     "bundle_index": node.get("bundle_index", -1),
                     "num_restarts": a["num_restarts"]}
@@ -827,6 +925,7 @@ class GcsService:
     def kv_put(self, key: str, value: bytes) -> bool:
         with self._lock:
             self._kv[key] = value
+            self._persist_delta("_kv", key, value)
         return True
 
     def kv_get(self, key: str) -> Optional[bytes]:
@@ -835,7 +934,10 @@ class GcsService:
 
     def kv_del(self, key: str) -> bool:
         with self._lock:
-            return self._kv.pop(key, None) is not None
+            hit = self._kv.pop(key, None) is not None
+            if hit:
+                self._persist_delta("_kv", key, None)
+            return hit
 
     def kv_keys(self, prefix: str = "") -> List[str]:
         with self._lock:
@@ -1021,6 +1123,7 @@ class GcsService:
                             "state": "CREATED",
                             "rr": 0,
                         }
+                        self._persist_delta("_pgs", pg_id, self._pgs[pg_id])
                 if removed:
                     # remove_placement_group raced the (re)creation: undo
                     # the fresh leases instead of leaking them ownerlessly.
@@ -1069,6 +1172,8 @@ class GcsService:
     def remove_placement_group(self, pg_id: str) -> bool:
         with self._lock:
             pg = self._pgs.pop(pg_id, None)
+            if pg is not None:
+                self._persist_delta("_pgs", pg_id, None)
             # Tombstone: an in-flight gang reschedule must not resurrect a
             # removed PG (and re-lease its bundles ownerlessly).
             self._removed_pgs[pg_id] = True
@@ -1133,6 +1238,7 @@ class GcsService:
                 "state": "PENDING",
                 "rr": 0,
             }
+            self._persist_delta("_pgs", pg_id, self._pgs[pg_id])
         return True
 
     def retry_pending_placement_group(self, pg_id: str) -> Optional[dict]:

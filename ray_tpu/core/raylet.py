@@ -1487,10 +1487,10 @@ class RayletService:
         for dep_hex in entry.get("deps", []):
             oid = ObjectID.from_hex(dep_hex)
             if not self.store.contains(oid):
-                # Kick off a pull; non-blocking check next round.
-                threading.Thread(
-                    target=self.pull_object, args=(dep_hex,), daemon=True
-                ).start()
+                # Kick off a DEDUPED pull; non-blocking check next round.
+                # (The scheduler rescans waiting entries ~20x/s — a raw
+                # thread per miss per scan once fork-bombed the node.)
+                self._pull_async(dep_hex)
                 return False
         return True
 

@@ -408,25 +408,111 @@ class Dataset:
         return out or [api.put(whole)]
 
     def _shuffle(self, refs: List[Any], seed: Optional[int]) -> List[Any]:
-        n_out = max(1, len(refs))
-        blocks = api.get(refs)
-        rows = []
-        for b in blocks:
-            rows.extend(BlockAccessor(b).iter_rows())
-        rng = random.Random(seed)
-        rng.shuffle(rows)
-        per = (len(rows) + n_out - 1) // n_out if rows else 1
+        """Distributed random shuffle: map tasks scatter each block's rows
+        into P random partitions, reduce tasks concatenate + locally
+        permute partition j — all data moves block-ref to block-ref over
+        the object plane, never through the driver (reference: the
+        push-based shuffle exchange, _internal/planner/exchange/
+        shuffle_task_scheduler)."""
+        if not refs:
+            return []
+        # Output block count follows the input (downstream parallelism is
+        # preserved) up to a cap that bounds the P x blocks intermediate
+        # object count on small test clusters.
+        P = max(1, min(len(refs), 32))
+
+        @api.remote
+        def scatter(block: Block, salt: int, P=P):
+            rng = random.Random(salt)
+            parts: List[List[Any]] = [[] for _ in _range(P)]
+            for row in BlockAccessor(block).iter_rows():
+                parts[rng.randrange(P)].append(row)
+            out = tuple(block_from_rows(p) for p in parts)
+            return out if P > 1 else out[0]
+
+        base = seed if seed is not None else random.randrange(1 << 30)
+        part_refs = [
+            scatter.options(num_returns=P).remote(r, base + i)
+            for i, r in enumerate(refs)
+        ]
+        if P == 1:
+            part_refs = [[r] for r in part_refs]
+
+        @api.remote
+        def merge(salt: int, *parts):
+            rows: List[Any] = []
+            for b in parts:
+                rows.extend(BlockAccessor(b).iter_rows())
+            random.Random(salt).shuffle(rows)
+            return block_from_rows(rows)
+
         return [
-            api.put(block_from_rows(rows[i : i + per])) for i in _range(0, len(rows), per)
-        ] or [api.put(block_from_rows([]))]
+            merge.remote(base ^ (j + 1), *[part_refs[i][j] for i in _range(len(part_refs))])
+            for j in _range(P)
+        ]
 
     def _sort(self, refs: List[Any], op: _Op) -> List[Any]:
-        blocks = api.get(refs)
-        rows = []
-        for b in blocks:
-            rows.extend(BlockAccessor(b).iter_rows())
-        rows.sort(key=lambda r: r[op.key], reverse=op.descending)
-        return [api.put(block_from_rows(rows))]
+        """Distributed sample-based range-partition sort (reference: the
+        sort exchange, _internal/planner/exchange/sort_task_spec.py
+        SortTaskSpec.sample_boundaries): only a small KEY SAMPLE crosses
+        the driver; rows move map-task -> reduce-task over the object
+        plane. Output blocks are globally ordered partition by partition."""
+        if not refs:
+            return []
+        key, desc = op.key, op.descending
+        P = max(1, min(len(refs), 32))  # see _shuffle on the cap
+        if P == 1:
+            blocks = api.get(refs)
+            rows = []
+            for b in blocks:
+                rows.extend(BlockAccessor(b).iter_rows())
+            rows.sort(key=lambda r: r[key], reverse=desc)
+            return [api.put(block_from_rows(rows))]
+
+        @api.remote
+        def sample_keys(block: Block, key=key):
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            step = max(1, n // 16)
+            return [row[key] for i, row in enumerate(acc.iter_rows()) if i % step == 0]
+
+        samples = sorted(
+            k for ks in api.get([sample_keys.remote(r) for r in refs]) for k in ks
+        )
+        boundaries = [
+            samples[(i + 1) * len(samples) // P] for i in _range(P - 1)
+        ] if samples else []
+
+        @api.remote
+        def partition(block: Block, boundaries=tuple(boundaries), key=key, desc=desc, P=P):
+            import bisect
+
+            parts: List[List[Any]] = [[] for _ in _range(P)]
+            bounds = list(boundaries)
+            for row in BlockAccessor(block).iter_rows():
+                # Ascending range index; descending output just reverses
+                # the partition order.
+                idx = bisect.bisect_right(bounds, row[key]) if bounds else 0
+                if desc:
+                    idx = P - 1 - idx
+                parts[idx].append(row)
+            out = tuple(block_from_rows(p) for p in parts)
+            return out if P > 1 else out[0]
+
+        part_refs = [partition.options(num_returns=P).remote(r) for r in refs]
+
+        @api.remote
+        def sort_partition(key, desc, *parts):
+            rows: List[Any] = []
+            for b in parts:
+                rows.extend(BlockAccessor(b).iter_rows())
+            rows.sort(key=lambda r: r[key], reverse=desc)
+            return block_from_rows(rows)
+
+        return [
+            sort_partition.remote(key, desc, *[part_refs[i][j] for i in _range(len(part_refs))])
+            for j in _range(P)
+        ]
 
     def _groupby(self, refs: List[Any], op: _Op) -> List[Any]:
         """Distributed hash-shuffle groupby (reference: the shuffle-based
@@ -436,7 +522,7 @@ class Dataset:
         groups/aggregates locally."""
         if not refs:
             return []
-        P = max(1, min(len(refs), 8))
+        P = max(1, min(len(refs), 32))
         key, aggs, group_fn = op.key, op.aggs, op.group_fn
 
         @api.remote
@@ -532,11 +618,16 @@ class Dataset:
             ahead = max(0, int(prefetch_batches))
             window: "collections.deque" = collections.deque()
             for ref in self.iter_block_refs():
-                window.append(ref.future())
+                # Keep the REF alive alongside its future: dropping it
+                # would let owner refcounting free the block before the
+                # prefetched fetch completes.
+                window.append((ref, ref.future()))
                 while len(window) > ahead:
-                    yield window.popleft().result()
+                    _ref, fut = window.popleft()
+                    yield fut.result()
             while window:
-                yield window.popleft().result()
+                _ref, fut = window.popleft()
+                yield fut.result()
 
         yield from rebatch_blocks(
             block_iter(),

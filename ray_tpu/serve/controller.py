@@ -300,6 +300,28 @@ class ServeController:
             self.delete_app(child)
         return True
 
+    def _drain_then_kill(self, replica, timeout_s: float = 30.0) -> None:
+        """Waits for a de-routed replica's in-flight requests (bounded),
+        then kills it (reference: replica graceful_shutdown_timeout_s)."""
+        from .. import exceptions as exc
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                if api.get(replica.queue_len.remote(), timeout=5) == 0:
+                    break
+            except exc.GetTimeoutError:
+                # Busy (every concurrency slot occupied by long requests) —
+                # exactly the case draining exists for: keep waiting.
+                continue
+            except Exception:
+                break  # actor already dead
+            time.sleep(0.25)
+        try:
+            api.kill(replica)
+        except Exception:
+            pass
+
     # ---------------------------------------------------------- reconcile
     def _reconcile(self) -> None:
         """Drives actual replica sets toward targets (reference:
@@ -322,13 +344,10 @@ class ServeController:
                 current.append(r)
                 created.append(r)
                 changed = True
+            victims = []
             while len(current) > target:
-                victim = current.pop()
+                victims.append(current.pop())
                 changed = True
-                try:
-                    api.kill(victim)
-                except Exception:
-                    pass
             with self._lock:
                 stale = self._app_gen.get(name, 0) != gens.get(name, 0) or name not in self._apps
                 if not stale:
@@ -337,12 +356,23 @@ class ServeController:
                         self._version += 1
             if stale:
                 # The app was redeployed/deleted mid-pass: our replicas run
-                # outdated code — tear them down instead of publishing them.
-                for r in created:
+                # outdated code — tear them down instead of publishing them
+                # (deploy/delete handles the previously published set).
+                for r in created + victims:
                     try:
                         api.kill(r)
                     except Exception:
                         pass
+                continue
+            # Graceful drain (reference: deployment_state graceful
+            # shutdown) — started only AFTER the shrunken replica list is
+            # published: routers stop sending new work first, THEN the
+            # victim finishes in-flight requests and dies (a drain racing
+            # publication could kill an idle victim still being routed to).
+            for victim in victims:
+                threading.Thread(
+                    target=self._drain_then_kill, args=(victim,), daemon=True
+                ).start()
 
     def _control_loop(self) -> None:
         while not self._stop.wait(0.25):

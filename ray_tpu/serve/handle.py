@@ -13,6 +13,7 @@ reference's queue-length-probe scheduler without the probe RPC.
 from __future__ import annotations
 
 import json
+import os
 import random
 import threading
 from typing import Any, Dict, List, Optional
@@ -238,11 +239,37 @@ class ProxyASGIApp:
     deployment handle; generator handlers stream as chunked responses;
     bytes bodies pass through untouched (non-JSON friendly)."""
 
+    # Backpressure: the proxy admits a bounded number of in-flight
+    # requests and sheds the rest with 503 instead of queueing without
+    # limit (reference: proxy.py's max_ongoing-based admission; env
+    # override RAY_TPU_PROXY_MAX_INFLIGHT).
+    MAX_INFLIGHT = int(os.environ.get("RAY_TPU_PROXY_MAX_INFLIGHT", "256"))
+
     def __init__(self, proxy: "_ProxyServer"):
         self._proxy = proxy
+        self._inflight = [0]
+        self._inflight_lock = threading.Lock()
 
     async def __call__(self, scope, receive, send):
         assert scope["type"] == "http"
+        with self._inflight_lock:
+            if self._inflight[0] >= self.MAX_INFLIGHT:
+                shed = True
+            else:
+                shed = False
+                self._inflight[0] += 1
+        if shed:
+            await self._respond_json(
+                send, 503, {"error": "proxy saturated; retry later"}
+            )
+            return
+        try:
+            await self._serve_one(scope, receive, send)
+        finally:
+            with self._inflight_lock:
+                self._inflight[0] -= 1
+
+    async def _serve_one(self, scope, receive, send):
         path = scope["path"].strip("/")
         app = path.split("/")[0] if path else ""
 
