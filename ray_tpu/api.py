@@ -153,6 +153,21 @@ def _process_args(args, kwargs):
     return tuple(conv(a) for a in args), {k: conv(v) for k, v in (kwargs or {}).items()}
 
 
+def _validate_concurrency_groups(groups):
+    if groups is None:
+        return None
+    if not isinstance(groups, dict):
+        raise TypeError("concurrency_groups must be a Dict[str, int]")
+    for name, width in groups.items():
+        if not isinstance(name, str) or not name:
+            raise ValueError(f"concurrency group name {name!r} must be a non-empty string")
+        if not isinstance(width, int) or width <= 0:
+            raise ValueError(
+                f"concurrency group {name!r} width must be a positive int, got {width!r}"
+            )
+    return dict(groups)
+
+
 def _build_sched_options(opts: Dict[str, Any], for_actor: bool = False) -> SchedulingOptions:
     bad = set(opts) - _VALID_OPTIONS
     if bad:
@@ -232,6 +247,7 @@ def _build_sched_options(opts: Dict[str, Any], for_actor: bool = False) -> Sched
         retry_exceptions=bool(opts.get("retry_exceptions", False)),
         scheduling_strategy=strategy if isinstance(strategy, str) else "DEFAULT",
         max_concurrency=opts.get("max_concurrency", 1),
+        concurrency_groups=_validate_concurrency_groups(opts.get("concurrency_groups")),
         max_restarts=opts.get("max_restarts", 0),
         name=opts.get("name"),
         namespace=opts.get("namespace"),
@@ -308,13 +324,25 @@ class RemoteFunction:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+    def __init__(
+        self,
+        handle: "ActorHandle",
+        method_name: str,
+        num_returns: int = 1,
+        concurrency_group: Optional[str] = None,
+    ):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def options(self, **opts) -> "ActorMethod":
-        m = ActorMethod(self._handle, self._method_name, opts.get("num_returns", self._num_returns))
+        m = ActorMethod(
+            self._handle,
+            self._method_name,
+            opts.get("num_returns", self._num_returns),
+            opts.get("concurrency_group", self._concurrency_group),
+        )
         return m
 
     def bind(self, *args, **kwargs):
@@ -324,7 +352,10 @@ class ActorMethod:
         return ClassMethodNode(self, args, kwargs)
 
     def remote(self, *args, **kwargs):
-        return self._handle._invoke(self._method_name, args, kwargs, self._num_returns)
+        return self._handle._invoke(
+            self._method_name, args, kwargs, self._num_returns,
+            concurrency_group=self._concurrency_group,
+        )
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -343,7 +374,10 @@ class ActorHandle:
     def _id(self) -> ActorID:
         return self._actor_id
 
-    def _invoke(self, method_name: str, args, kwargs, num_returns: int):
+    def _invoke(
+        self, method_name: str, args, kwargs, num_returns: int,
+        concurrency_group: Optional[str] = None,
+    ):
         rt = current_runtime()
         pargs, pkwargs = _process_args(args, kwargs)
         spec = TaskSpec(
@@ -357,6 +391,7 @@ class ActorHandle:
             num_returns=num_returns,
             options=SchedulingOptions(),
             actor_id=self._actor_id,
+            concurrency_group=concurrency_group,
         )
         return_ids = rt.submit_actor_task(spec)
         if num_returns == "streaming":
@@ -372,7 +407,9 @@ class ActorHandle:
         meta = self._method_meta.get(name)
         if meta is None:
             raise AttributeError(f"actor has no method {name!r}")
-        return ActorMethod(self, name, meta.get("num_returns", 1))
+        return ActorMethod(
+            self, name, meta.get("num_returns", 1), meta.get("concurrency_group")
+        )
 
     def __reduce__(self):
         return (ActorHandle, (self._actor_id, self._method_meta))
@@ -426,6 +463,14 @@ class ActorClass:
             options=opts,
             actor_id=ActorID.from_random(),
         )
+        declared = set((opts.concurrency_groups or {}).keys())
+        for mname, meta in self._method_meta.items():
+            g = meta.get("concurrency_group")
+            if g and g not in declared:
+                raise ValueError(
+                    f"method {mname!r} targets undeclared concurrency group {g!r}; "
+                    f"declared: {sorted(declared)}"
+                )
         actor_id = rt.create_actor(spec)
         return ActorHandle(actor_id, self._method_meta)
 

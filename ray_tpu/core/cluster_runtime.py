@@ -73,6 +73,8 @@ def _entry_from_spec(spec: TaskSpec) -> dict:
         "max_restarts": spec.options.max_restarts,
         "max_retries": spec.options.max_retries,
         "max_concurrency": spec.options.max_concurrency,
+        "concurrency_groups": spec.options.concurrency_groups,
+        "concurrency_group": spec.concurrency_group,
         "runtime_env": spec.options.runtime_env,
         "attempt": 0,
         "strategy": spec.options.scheduling_strategy,

@@ -196,6 +196,7 @@ def actor_frame(entry: dict) -> tuple:
         entry["return_ids"],
         entry.get("desc", ""),
         bool(entry.get("streaming")),
+        entry.get("concurrency_group"),
     )
 
 

@@ -27,6 +27,16 @@ _OK = 0
 _ERR = 1
 
 
+def _declared_group(instance, method_name: str) -> Optional[str]:
+    """The method's decorator-declared concurrency group — the fallback
+    when the caller's handle (e.g. get_actor's dynamic handle) didn't
+    carry one."""
+    if instance is None or not method_name:
+        return None
+    m = getattr(type(instance), method_name, None)
+    return getattr(m, "__ray_tpu_method_options__", {}).get("concurrency_group")
+
+
 class _ActorState:
     def __init__(
         self,
@@ -34,12 +44,21 @@ class _ActorState:
         max_concurrency: int,
         name: Optional[str],
         namespace: str = "default",
+        concurrency_groups: Optional[Dict[str, int]] = None,
     ):
         self.actor_id = actor_id
         self.instance: Any = None
         self.pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(1, max_concurrency), thread_name_prefix=f"actor-{actor_id.hex()[:6]}"
         )
+        # Named concurrency groups: independent executors (reference:
+        # concurrency_group_manager.h:34).
+        self.group_pools = {
+            g: concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(1, int(w)), thread_name_prefix=f"cg-{g}"
+            )
+            for g, w in (concurrency_groups or {}).items()
+        }
         self.name = name
         self.namespace = namespace
         self.dead = False
@@ -50,6 +69,9 @@ class _ActorState:
         self.pending_lock = threading.Lock()
         # Completed once the constructor has run (methods are gated on it).
         self.ready_future: concurrent.futures.Future = concurrent.futures.Future()
+
+    def executor_for(self, group: Optional[str]):
+        return self.group_pools.get(group, self.pool) if group else self.pool
 
 
 class LocalRuntime(Runtime):
@@ -364,7 +386,13 @@ class LocalRuntime(Runtime):
         actor_id = spec.actor_id or ActorID.from_random()
         spec.actor_id = actor_id
         namespace = spec.options.namespace or "default"
-        state = _ActorState(actor_id, spec.options.max_concurrency, spec.options.name, namespace)
+        state = _ActorState(
+            actor_id,
+            spec.options.max_concurrency,
+            spec.options.name,
+            namespace,
+            spec.options.concurrency_groups,
+        )
         with self._actor_lock:
             if spec.options.name:
                 key = (namespace, spec.options.name)
@@ -448,7 +476,12 @@ class LocalRuntime(Runtime):
         # half-constructed instance (even with max_concurrency > 1).
         self._after_deps(
             spec,
-            lambda: state.ready_future.add_done_callback(lambda _f: state.pool.submit(execute)),
+            lambda: state.ready_future.add_done_callback(
+                lambda _f: state.executor_for(
+                    spec.concurrency_group
+                    or _declared_group(state.instance, spec.method_name)
+                ).submit(execute)
+            ),
         )
         return spec.return_ids
 
@@ -468,6 +501,8 @@ class LocalRuntime(Runtime):
             if state.name:
                 self._named_actors.pop((state.namespace, state.name), None)
         state.pool.shutdown(wait=False, cancel_futures=True)
+        for gp in state.group_pools.values():
+            gp.shutdown(wait=False, cancel_futures=True)
         # Resolve queued-but-cancelled calls so get() on them raises instead
         # of hanging (reference parity: RayActorError on killed actors).
         with state.pending_lock:
@@ -525,3 +560,5 @@ class LocalRuntime(Runtime):
             actors = list(self._actors.values())
         for a in actors:
             a.pool.shutdown(wait=False, cancel_futures=True)
+            for gp in a.group_pools.values():
+                gp.shutdown(wait=False, cancel_futures=True)

@@ -38,6 +38,10 @@ class SchedulingOptions:
     scheduling_strategy: str = "DEFAULT"   # DEFAULT | SPREAD | NODE:<id>
     max_concurrency: int = 1               # actors only
     max_restarts: int = 0                  # actors only
+    # Named method groups with independent concurrency limits (reference:
+    # src/ray/core_worker/transport/concurrency_group_manager.h:34) —
+    # {"io": 4, "compute": 1}; methods opt in via @method(concurrency_group=...).
+    concurrency_groups: Optional[Dict[str, int]] = None
     name: Optional[str] = None             # named actor
     namespace: Optional[str] = None
     lifetime: Optional[str] = None         # None | "detached"
@@ -61,6 +65,7 @@ class TaskSpec:
     actor_id: Optional[ActorID] = None
     return_ids: List[ObjectID] = field(default_factory=list)
     attempt: int = 0
+    concurrency_group: Optional[str] = None  # actor calls: target group
 
     def description(self) -> str:
         if self.task_type == TaskType.ACTOR_TASK:

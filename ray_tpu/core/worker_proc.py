@@ -80,14 +80,18 @@ def _apply_working_dir(runtime_env: dict) -> None:
 
 class _AsyncLoop:
     """A dedicated asyncio event loop thread for async actors
-    (reference: fiber.h / async actor event loop in _raylet.pyx)."""
+    (reference: fiber.h / async actor event loop in _raylet.pyx).
+    Named concurrency groups get independent semaphores (reference:
+    concurrency_group_manager.h:34 — per-group executors)."""
 
-    def __init__(self, concurrency: int):
+    def __init__(self, concurrency: int, groups=None):
         import asyncio
 
         self._asyncio = asyncio
         self.loop = asyncio.new_event_loop()
         self.sem = None
+        self.group_sems = {}
+        self._groups = dict(groups or {})
         self.concurrency = concurrency
         t = threading.Thread(target=self._run, daemon=True, name="actor-aio")
         t.start()
@@ -95,11 +99,16 @@ class _AsyncLoop:
     def _run(self):
         self._asyncio.set_event_loop(self.loop)
         self.sem = self._asyncio.Semaphore(self.concurrency)
+        self.group_sems = {
+            k: self._asyncio.Semaphore(max(1, int(v)))
+            for k, v in self._groups.items()
+        }
         self.loop.run_forever()
 
-    def submit(self, coro_fn, done_cb):
+    def submit(self, coro_fn, done_cb, group=None):
         async def wrapped():
-            async with self.sem:
+            sem = self.group_sems.get(group) or self.sem
+            async with sem:
                 return await coro_fn()
 
         fut = self._asyncio.run_coroutine_threadsafe(wrapped(), self.loop)
@@ -413,6 +422,7 @@ def main(argv: List[str]) -> None:
 
     # ----- concurrent actor executors -------------------------------------
     pool: Optional[Any] = None  # ThreadPoolExecutor for threaded actors
+    group_pools: Dict[str, Any] = {}  # named concurrency groups
     aio: Optional[_AsyncLoop] = None
 
     def create_actor(entry: dict, sealed: List[str]) -> bool:
@@ -426,6 +436,7 @@ def main(argv: List[str]) -> None:
             inst = cls(*args, **kwargs)
             actor_instance[entry["actor_id"]] = inst
             mc = int(entry.get("max_concurrency", 1) or 1)
+            cgroups = entry.get("concurrency_groups") or {}
             # Scan the CLASS, not the instance: getattr on the instance
             # would execute @property getters during creation.
             has_async = any(
@@ -434,13 +445,21 @@ def main(argv: List[str]) -> None:
                 if not m.startswith("_")
             )
             if has_async:
-                aio = _AsyncLoop(max(1, mc))
-            elif mc > 1:
+                aio = _AsyncLoop(max(1, mc), groups=cgroups)
+            elif mc > 1 or cgroups:
                 import concurrent.futures
 
+                # Default pool runs ungrouped methods at max_concurrency;
+                # each named group gets its own executor of its declared
+                # width (reference: concurrency_group_manager.h:34).
                 pool = concurrent.futures.ThreadPoolExecutor(
-                    max_workers=mc, thread_name_prefix="actor"
+                    max_workers=max(1, mc), thread_name_prefix="actor"
                 )
+                for gname, width in cgroups.items():
+                    group_pools[gname] = concurrent.futures.ThreadPoolExecutor(
+                        max_workers=max(1, int(width)),
+                        thread_name_prefix=f"cg-{gname}",
+                    )
             store_returns(entry, None, sealed)
             return True
         except SystemExit:
@@ -493,11 +512,24 @@ def main(argv: List[str]) -> None:
             # event loop thread or concurrent coroutines stall behind it.
             threading.Thread(target=finish, args=(fut,), daemon=True).start()
 
-        aio.submit(coro, on_done)
+        aio.submit(coro, on_done, _group_for(entry))
+
+    def _group_for(entry: dict):
+        g = entry.get("concurrency_group")
+        if g:
+            return g
+        # Fallback to the method's decorator-declared group: handles from
+        # get_actor() (dynamic, no method metadata) must still route.
+        inst = actor_instance.get(entry.get("actor_id") or "")
+        if inst is None or not entry.get("method_name"):
+            return None
+        m = getattr(type(inst), entry["method_name"], None)
+        return getattr(m, "__ray_tpu_method_options__", {}).get("concurrency_group")
 
     def exec_threaded(entry: dict, report=None) -> None:
         if report is None:
             report = done
+        target_pool = group_pools.get(_group_for(entry)) or pool
         def run():
             sealed: List[str] = []
             try:
@@ -508,7 +540,7 @@ def main(argv: List[str]) -> None:
                 return
             report(entry, ok, sealed)
 
-        pool.submit(run)
+        target_pool.submit(run)
 
     # ----- direct server --------------------------------------------------
     def _exec_direct_actor(entry: dict, send_done) -> None:
@@ -595,7 +627,7 @@ def main(argv: List[str]) -> None:
                         entry["_stream_report"] = _make_stream_report(send_raw)
                     direct_inbox.put((entry, send_done))
                 elif kind == "a":
-                    _, tid, aid, method, ab, rids, desc, streaming = frame
+                    _, tid, aid, method, ab, rids, desc, streaming, cgroup = frame
                     entry = {
                         "type": "actor_task",
                         "task_id": tid,
@@ -605,6 +637,7 @@ def main(argv: List[str]) -> None:
                         "return_ids": rids,
                         "desc": desc,
                         "streaming": streaming,
+                        "concurrency_group": cgroup,
                         "_inline": {},
                     }
                     if streaming:
