@@ -1,0 +1,378 @@
+"""Preallocated SPSC channels: the compiled-DAG data plane.
+
+Re-design of the reference's channel layer (reference:
+python/ray/experimental/channel/shared_memory_channel.py:159 Channel —
+mutable-plasma ring written per execute; torch_tensor_nccl_channel.py:42
+for the device direction). The TPU-native layout keeps the same role —
+steady-state DAG execution is a channel write, not a task submission —
+with two transports behind one descriptor:
+
+- **shm ring** (node-local): an mmap'd ring buffer file holding
+  length-prefixed pickled records, plus a tiny UDS used purely for
+  blocking wakeups (data never rides it). Writer blocks when the ring is
+  full (backpressure), reader blocks when empty. Positions are monotonic
+  u64s so free space is one subtraction.
+- **tcp stream** (cross-node / DCN): length-prefixed frames over one
+  persistent socket; kernel flow control is the backpressure.
+
+The READER hosts the channel (creates the ring file + listener); writers
+attach by descriptor. Writers pick shm when the ring file is reachable on
+their filesystem, else tcp — single-host multi-node tests exercise the shm
+path, true multi-host falls back to the stream.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import mmap
+import os
+import pickle
+import select
+import socket
+import struct
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+_HDR = struct.Struct("<QQI")  # write_pos, read_pos, closed
+_LEN = struct.Struct("<I")
+_WRAP = 0xFFFFFFFF
+_DATA_OFF = 64  # header page; positions are offsets into the data region
+
+
+class ChannelClosed(Exception):
+    """The peer closed the channel (teardown or process death)."""
+
+
+class ChannelSpec:
+    """Serializable descriptor a writer uses to attach."""
+
+    __slots__ = ("name", "ring_path", "uds_path", "tcp_addr", "capacity")
+
+    def __init__(self, name, ring_path, uds_path, tcp_addr, capacity):
+        self.name = name
+        self.ring_path = ring_path
+        self.uds_path = uds_path
+        self.tcp_addr = tcp_addr  # (host, port)
+        self.capacity = capacity
+
+    def __reduce__(self):
+        return (
+            ChannelSpec,
+            (self.name, self.ring_path, self.uds_path, self.tcp_addr, self.capacity),
+        )
+
+
+def _align(n: int) -> int:
+    return (n + 7) & ~7
+
+
+class _Ring:
+    """Shared-memory ring state over an mmap'd file."""
+
+    def __init__(self, path: str, capacity: int, create: bool):
+        self.capacity = capacity
+        size = _DATA_OFF + capacity
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        fd = os.open(path, flags, 0o600)
+        try:
+            if create:
+                os.ftruncate(fd, size)
+            self.mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        if create:
+            _HDR.pack_into(self.mm, 0, 0, 0, 0)
+
+    # positions are monotonic; offset = pos % capacity
+    def header(self):
+        try:
+            return _HDR.unpack_from(self.mm, 0)
+        except ValueError:  # mmap closed under a concurrent reader/writer
+            raise ChannelClosed("ring unmapped")
+
+    def set_write_pos(self, pos: int):
+        struct.pack_into("<Q", self.mm, 0, pos)
+
+    def set_read_pos(self, pos: int):
+        struct.pack_into("<Q", self.mm, 8, pos)
+
+    def set_closed(self):
+        struct.pack_into("<I", self.mm, 16, 1)
+
+    def write_record(self, wpos: int, payload) -> int:
+        """Writes one record at wpos (caller checked space); returns new wpos."""
+        cap = self.capacity
+        n = len(payload)
+        off = wpos % cap
+        if cap - off < _LEN.size:
+            # No room even for a length: implicit wrap (reader mirrors).
+            wpos += cap - off
+            off = 0
+        elif cap - off < _LEN.size + n:
+            # Length fits but payload would split: explicit wrap marker.
+            _LEN.pack_into(self.mm, _DATA_OFF + off, _WRAP)
+            wpos += cap - off
+            off = 0
+        _LEN.pack_into(self.mm, _DATA_OFF + off, n)
+        self.mm[_DATA_OFF + off + _LEN.size : _DATA_OFF + off + _LEN.size + n] = payload
+        return wpos + _align(_LEN.size + n)
+
+    def space_needed(self, wpos: int, n: int) -> int:
+        """Exact ring bytes consumed writing an n-byte payload at wpos —
+        includes the skipped tail when the record wraps."""
+        cap = self.capacity
+        off = wpos % cap
+        rec = _align(_LEN.size + n)
+        if cap - off < _LEN.size + n:  # wraps (implicitly or via marker)
+            return (cap - off) + rec
+        return rec
+
+    def read_record(self, rpos: int) -> tuple:
+        """Returns (payload_bytes, new_rpos). Caller checked non-empty."""
+        cap = self.capacity
+        off = rpos % cap
+        if cap - off < _LEN.size:
+            rpos += cap - off
+            off = 0
+        (n,) = _LEN.unpack_from(self.mm, _DATA_OFF + off)
+        if n == _WRAP:
+            rpos += cap - off
+            off = 0
+            (n,) = _LEN.unpack_from(self.mm, _DATA_OFF + off)
+        start = _DATA_OFF + off + _LEN.size
+        payload = bytes(self.mm[start : start + n])
+        return payload, rpos + _align(_LEN.size + n)
+
+    def close(self):
+        with contextlib.suppress(Exception):
+            self.mm.close()
+
+
+def _drain(sock: socket.socket) -> bool:
+    """Consumes pending wakeup tokens; False when the peer closed."""
+    try:
+        while True:
+            data = sock.recv(4096)
+            if not data:
+                return False
+    except (BlockingIOError, InterruptedError):
+        return True
+    except OSError:
+        return False
+
+
+def _token(sock: Optional[socket.socket]) -> None:
+    if sock is None:
+        return
+    try:
+        sock.send(b"\x01")
+    except (BlockingIOError, InterruptedError):
+        pass  # peer has wakeups pending already
+    except OSError:
+        pass  # peer gone; positions/closed flag are authoritative
+
+
+class ChannelReader:
+    """Reader end; hosts the ring + listener. One reader per channel."""
+
+    def __init__(self, session_dir: str, name: Optional[str] = None, capacity: int = 8 << 20):
+        self.name = name or uuid.uuid4().hex[:12]
+        self.capacity = capacity
+        self._closed = False
+        base = os.path.join(session_dir, f"ch_{self.name}")
+        self.ring_path = base + ".ring"
+        self.uds_path = base + ".sock"
+        self._ring = _Ring(self.ring_path, capacity, create=True)
+        with contextlib.suppress(OSError):
+            os.unlink(self.uds_path)
+        self._uds_srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._uds_srv.bind(self.uds_path)
+        self._uds_srv.listen(2)
+        self._tcp_srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._tcp_srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._tcp_srv.bind(("0.0.0.0", 0))
+        self._tcp_srv.listen(2)
+        port = self._tcp_srv.getsockname()[1]
+        host = os.environ.get("RAY_TPU_NODE_IP") or "127.0.0.1"
+        self.tcp_addr = (host, port)
+        self._conn: Optional[socket.socket] = None  # wakeup/credit (shm mode)
+        self._stream: Optional[socket.socket] = None  # data (tcp mode)
+        self._stream_buf = b""
+        self._lock = threading.Lock()
+
+    def spec(self) -> ChannelSpec:
+        return ChannelSpec(
+            self.name, self.ring_path, self.uds_path, self.tcp_addr, self.capacity
+        )
+
+    def _accept(self, timeout: Optional[float]) -> None:
+        """Waits for a writer to attach over UDS (shm mode) or TCP (stream)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._conn is None and self._stream is None:
+            remain = None if deadline is None else max(0.0, deadline - time.monotonic())
+            r, _, _ = select.select([self._uds_srv, self._tcp_srv], [], [], remain)
+            if not r:
+                raise TimeoutError(f"channel {self.name}: no writer attached")
+            srv = r[0]
+            conn, _ = srv.accept()
+            if srv is self._uds_srv:
+                conn.setblocking(False)
+                self._conn = conn
+            else:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._stream = conn
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        payload = self.read_bytes(timeout)
+        return pickle.loads(payload)
+
+    def read_bytes(self, timeout: Optional[float] = None) -> bytes:
+        if self._closed:
+            raise ChannelClosed(self.name)
+        if self._conn is None and self._stream is None:
+            self._accept(timeout)
+        if self._stream is not None:
+            return self._read_stream(timeout)
+        return self._read_ring(timeout)
+
+    def _read_ring(self, timeout: Optional[float]) -> bytes:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            wpos, rpos, closed = self._ring.header()
+            if wpos != rpos:
+                payload, new_rpos = self._ring.read_record(rpos)
+                self._ring.set_read_pos(new_rpos)
+                _token(self._conn)  # credit: unblock a full writer
+                return payload
+            if closed:
+                raise ChannelClosed(self.name)
+            remain = None if deadline is None else max(0.0, deadline - time.monotonic())
+            r, _, _ = select.select([self._conn], [], [], remain)
+            if not r:
+                raise TimeoutError(f"channel {self.name}: empty after {timeout}s")
+            if not _drain(self._conn):
+                # Writer hung up; drain anything it published first.
+                wpos, rpos, closed = self._ring.header()
+                if wpos == rpos:
+                    raise ChannelClosed(self.name)
+
+    def _read_stream(self, timeout: Optional[float]) -> bytes:
+        sock = self._stream
+        sock.settimeout(timeout)
+        try:
+            need = _LEN.size
+            while len(self._stream_buf) < need:
+                chunk = sock.recv(1 << 20)
+                if not chunk:
+                    raise ChannelClosed(self.name)
+                self._stream_buf += chunk
+            (n,) = _LEN.unpack_from(self._stream_buf, 0)
+            need = _LEN.size + n
+            while len(self._stream_buf) < need:
+                chunk = sock.recv(1 << 20)
+                if not chunk:
+                    raise ChannelClosed(self.name)
+                self._stream_buf += chunk
+            payload = self._stream_buf[_LEN.size : need]
+            self._stream_buf = self._stream_buf[need:]
+            return payload
+        except socket.timeout:
+            raise TimeoutError(f"channel {self.name}: empty after {timeout}s")
+
+    def close(self) -> None:
+        self._closed = True
+        self._ring.set_closed()
+        for s in (self._conn, self._stream, self._uds_srv, self._tcp_srv):
+            if s is not None:
+                with contextlib.suppress(OSError):
+                    s.close()
+        self._ring.close()
+        for p in (self.ring_path, self.uds_path):
+            with contextlib.suppress(OSError):
+                os.unlink(p)
+
+
+class ChannelWriter:
+    """Writer end; attaches to a reader-hosted channel by descriptor."""
+
+    def __init__(self, spec: ChannelSpec, connect_timeout: float = 20.0):
+        self.spec = spec
+        self._closed = False
+        self._ring: Optional[_Ring] = None
+        self._sock: Optional[socket.socket] = None
+        self._stream: Optional[socket.socket] = None
+        deadline = time.monotonic() + connect_timeout
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                if os.path.exists(spec.ring_path):
+                    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                    s.connect(spec.uds_path)
+                    s.setblocking(False)
+                    self._sock = s
+                    self._ring = _Ring(spec.ring_path, spec.capacity, create=False)
+                else:
+                    s = socket.create_connection(spec.tcp_addr, timeout=5.0)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    self._stream = s
+                return
+            except OSError as e:
+                last = e
+                time.sleep(0.05)
+        raise ConnectionError(f"cannot attach channel {spec.name}: {last}")
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        self.write_bytes(pickle.dumps(value, protocol=5), timeout)
+
+    def write_bytes(self, payload: bytes, timeout: Optional[float] = None) -> None:
+        if self._closed:
+            raise ChannelClosed(self.spec.name)
+        if self._stream is not None:
+            self._stream.settimeout(timeout)
+            try:
+                self._stream.sendall(_LEN.pack(len(payload)) + payload)
+            except socket.timeout:
+                raise TimeoutError(f"channel {self.spec.name}: peer stalled")
+            except OSError:
+                raise ChannelClosed(self.spec.name)
+            return
+        ring = self._ring
+        # Half-capacity record cap: guarantees wrap-tail + record always fit
+        # in an empty ring ((cap-off)+rec < cap), so a full-size record can
+        # never deadlock waiting for space that cannot exist.
+        if _align(_LEN.size + len(payload)) > ring.capacity // 2:
+            raise ValueError(
+                f"record of {len(payload)} bytes exceeds half the channel "
+                f"capacity ({ring.capacity}); raise capacity at compile time"
+            )
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            wpos, rpos, closed = ring.header()
+            if closed:
+                raise ChannelClosed(self.spec.name)
+            need = ring.space_needed(wpos, len(payload))
+            if ring.capacity - (wpos - rpos) >= need:
+                new_wpos = ring.write_record(wpos, payload)
+                ring.set_write_pos(new_wpos)
+                _token(self._sock)
+                return
+            remain = None if deadline is None else max(0.0, deadline - time.monotonic())
+            r, _, _ = select.select([self._sock], [], [], remain)  # credit wait
+            if not r:
+                raise TimeoutError(
+                    f"channel {self.spec.name}: full after {timeout}s (backpressure)"
+                )
+            if not _drain(self._sock):
+                raise ChannelClosed(self.spec.name)
+
+    def close(self) -> None:
+        self._closed = True
+        for s in (self._sock, self._stream):
+            if s is not None:
+                with contextlib.suppress(OSError):
+                    s.close()
+        if self._ring is not None:
+            self._ring.close()

@@ -102,6 +102,16 @@ class RayletService:
         # raylet queued-task removal). Bounded FIFO: broadcast cancels leave
         # ids on raylets that never see the task.
         self._cancelled: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
+        # Submission dedupe: one-way submits are resent after a reconnect
+        # (rpc.py notify), and two-way submits are resent when the reply is
+        # lost — either way the same (task_id, attempt) may arrive twice.
+        # Keyed on attempt so owner-driven retries (a NEW attempt) pass.
+        # Bounded LRU; only the RPC ingress checks it — internal re-entry
+        # (soft-affinity fallback) legitimately re-ingests the same attempt.
+        self._seen_submits: "collections.OrderedDict[Tuple[str, int], List[bytes]]" = (
+            collections.OrderedDict()
+        )
+        self._seen_lock = threading.Lock()
 
         self._pending: "queue.Queue" = queue.Queue()  # task entries
         # Wakes the dispatch loop on any schedulability change (new task,
@@ -385,7 +395,11 @@ class RayletService:
     def submit_task(self, spec_blob: bytes, forwarded: bool = False) -> List[bytes]:
         """Queues a normal task; returns return-object ids. May forward to
         another node (spillback, reference: cluster_task_manager.cc:136)."""
-        return self._ingest_entry(pickle.loads(spec_blob), spec_blob, forwarded)
+        entry = pickle.loads(spec_blob)
+        dup = self._dedupe_submit(entry)
+        if dup is not None:
+            return dup
+        return self._ingest_entry(entry, spec_blob, forwarded)
 
     def submit_task_batch(self, batch_blob: bytes) -> int:
         """Batched one-way submission: owners coalesce bursts into one
@@ -393,8 +407,24 @@ class RayletService:
         submission-queue batching in NormalTaskSubmitter)."""
         entries = pickle.loads(batch_blob)
         for entry in entries:
-            self._ingest_entry(entry, None, False)
+            if self._dedupe_submit(entry) is None:
+                self._ingest_entry(entry, None, False)
         return len(entries)
+
+    def _dedupe_submit(self, entry: dict) -> Optional[List[bytes]]:
+        """Returns the prior return_ids when this (task_id, attempt) already
+        arrived at this node's RPC ingress — a reconnect-resend duplicate
+        (rpc.py call/notify both resend after reconnect; the first send may
+        have executed with its ack lost). None means first sighting."""
+        key = (entry["task_id"], entry.get("attempt", 0))
+        with self._seen_lock:
+            if key in self._seen_submits:
+                self._seen_submits.move_to_end(key)
+                return self._seen_submits[key]
+            self._seen_submits[key] = entry["return_ids"]
+            while len(self._seen_submits) > 65536:
+                self._seen_submits.popitem(last=False)
+        return None
 
     def _ingest_entry(
         self, entry: dict, spec_blob: Optional[bytes], forwarded: bool

@@ -323,6 +323,25 @@ def main(argv: List[str]) -> None:
             except Exception:
                 pass
 
+    def bind_method(inst, name: str):
+        """User method, or a framework builtin for reserved names — the
+        compiled-DAG entry points ride the normal actor-task path under
+        `__ray_dag_*__` names (reference: do_exec_tasks being a framework
+        function executed as an actor task, compiled_dag_node.py:133)."""
+        if name.startswith("__ray_dag_"):
+            from .dag_exec import bind_builtin
+
+            return bind_builtin(inst, name)
+        if name == "__ray_tpu_collective_init__":
+            from ..collective import init_collective_group
+
+            def _collective_init(ws, rank, gname):
+                init_collective_group(ws, rank, gname)
+                return True
+
+            return _collective_init
+        return getattr(inst, name)
+
     def run_body(entry: dict, sealed: List[str]) -> bool:
         """Executes one entry body synchronously (any thread)."""
         from .runtime_context import reset_task_context, set_task_context
@@ -344,7 +363,7 @@ def main(argv: List[str]) -> None:
                 inst = actor_instance.get(entry["actor_id"])
                 if inst is None:
                     raise RuntimeError("actor instance missing in worker")
-                method = getattr(inst, entry["method_name"])
+                method = bind_method(inst, entry["method_name"])
                 args, kwargs = _resolve_args(store, entry["args_blob"], raylet)
                 result = method(*args, **kwargs)
                 if inspect.iscoroutine(result):
@@ -487,7 +506,7 @@ def main(argv: List[str]) -> None:
             args, kwargs = await asyncio.get_running_loop().run_in_executor(
                 None, _resolve_args, store, entry["args_blob"], raylet
             )
-            method = getattr(inst, entry["method_name"])
+            method = bind_method(inst, entry["method_name"])
             result = method(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = await result

@@ -301,11 +301,12 @@ class Dataset:
     def iter_block_refs(
         self, prefetch: int = 8, memory_budget: Optional[int] = None
     ) -> Iterator[Any]:
-        """The streaming executor: yields refs to output blocks, keeping at
-        most `prefetch` block-task chains in flight (the pull window IS the
-        backpressure budget; `memory_budget` bytes additionally shrinks the
-        window to budget/block-size). Barrier stages (repartition/shuffle/
-        sort) materialize their input before streaming resumes."""
+        """The streaming executor: yields refs to output blocks. Chains of
+        streamable stages run under a pull-based per-operator state machine
+        (data/streaming.py — per-op in-flight caps, downstream-first
+        scheduling, memory-budget drain mode), so every stage processes
+        different blocks concurrently. Barrier stages (repartition/shuffle/
+        sort/groupby) materialize their input before streaming resumes."""
         import time as _time
 
         _ensure_initialized()
@@ -313,85 +314,114 @@ class Dataset:
         source, stages = self._plan_stages()
         refs: Iterator[Any] = self._source_iter(source)
 
+        pending_ops: List[Any] = []
+
+        def flush(refs_in: Iterator[Any]) -> Iterator[Any]:
+            nonlocal pending_ops
+            if not pending_ops:
+                return refs_in
+            from .streaming import StreamingExecutor
+
+            ops, pending_ops = pending_ops, []
+            return StreamingExecutor(
+                refs_in, ops, prefetch=max(1, prefetch), memory_budget=memory_budget
+            ).run_iter()
+
         for kind, payload in stages:
             if kind == "fused":
-                refs = self._launch_fused(refs, payload)
+                pending_ops.append(self._fused_stream_op(payload, prefetch))
             elif kind == "map_batches":
-                refs = self._launch_actor_pool(refs, payload)
+                pending_ops.append(self._actor_pool_stream_op(payload))
             elif kind == "repartition":
-                refs = iter(self._repartition(list(refs), payload.n))
+                refs = iter(self._repartition(list(flush(refs)), payload.n))
             elif kind == "shuffle":
-                refs = iter(self._shuffle(list(refs), payload.seed))
+                refs = iter(self._shuffle(list(flush(refs)), payload.seed))
             elif kind == "sort":
-                refs = iter(self._sort(list(refs), payload))
+                refs = iter(self._sort(list(flush(refs)), payload))
             elif kind == "groupby":
-                refs = iter(self._groupby(list(refs), payload))
+                refs = iter(self._groupby(list(flush(refs)), payload))
             elif kind == "limit":
-                refs = self._limit_iter(refs, payload.n)
+                refs = self._limit_iter(flush(refs), payload.n)
             else:  # pragma: no cover
                 raise ValueError(f"unknown stage {kind}")
+        refs = flush(refs)
 
         n = 0
-        for ref in _windowed(refs, max(1, prefetch), memory_budget):
-            n += 1
-            yield ref
-        self.stats.num_blocks = n
-        self.stats.wall_s = _time.perf_counter() - t0
+        try:
+            for ref in _windowed(refs, max(1, prefetch), memory_budget):
+                n += 1
+                yield ref
+        finally:
+            # Early consumer exit: stop a live executor (kills actor pools).
+            close = getattr(refs, "close", None)
+            if close is not None:
+                close()
+            self.stats.num_blocks = n
+            self.stats.wall_s = _time.perf_counter() - t0
 
-    def _launch_fused(self, refs: Iterator[Any], ops: List[_Op]) -> Iterator[Any]:
+    def _fused_stream_op(self, ops: List[_Op], prefetch: int):
+        from .streaming import StreamOp
+
         @api.remote
         def do_transform(block: Block, ops=ops) -> Block:
             return _apply_fused(block, ops)
 
-        return (do_transform.remote(r) for r in refs)
+        names = "+".join(o.kind for o in ops)
+        return StreamOp(
+            f"fused[{names}]",
+            lambda r: do_transform.remote(r),
+            cap=max(2, prefetch),
+        )
 
-    def _launch_actor_pool(self, refs: Iterator[Any], op: _Op) -> Iterator[Any]:
+    def _actor_pool_stream_op(self, op: _Op):
+        """Actor-pool stage (reference: actor_pool_map_operator.py:34):
+        the pool is created when the executor starts the stage and torn
+        down when the stage ends — including early consumer exit."""
         import cloudpickle
+
+        from .streaming import StreamOp
 
         n_actors = max(1, op.concurrency or 1)
         actor_cls = api.remote(max_concurrency=2)(_BatchMapActor)
         blob = cloudpickle.dumps(op.fn)
+        state: Dict[str, Any] = {"actors": [], "rr": 0}
 
-        def run():
-            # Kill the pool when the stage drains (or the consumer stops
-            # iterating): each execution owns its actors, and leaking one
-            # worker process per epoch per actor adds up fast. In-flight
-            # applies are awaited first so the kill can't fail them. Actors
-            # are created lazily here so a consumer that never starts the
-            # stage doesn't strand a pool (a GEN_CREATED generator's finally
-            # never runs).
-            actors = [actor_cls.remote(blob) for _ in _range(n_actors)]
-            issued = []
-            try:
-                for i, r in enumerate(refs):
-                    out = actors[i % n_actors].apply.remote(r, op.batch_size, op.batch_format)
-                    issued.append(out)
-                    yield out
-            finally:
-                # Poll until every issued ref has resolved. No overall cap —
-                # a slow tail UDF must not get its worker killed while refs
-                # already yielded downstream are still computing — but a
-                # LIVELOCKED UDF (no ref resolving for a sustained window)
-                # must not hang the consumer forever, so zero progress for
-                # 60s escapes to the kill below.
-                pending = list(issued)
-                stalled = 0.0
-                while pending and stalled < 60.0:
-                    try:
-                        before = len(pending)
-                        _, pending = api.wait(
-                            pending, num_returns=len(pending), timeout=5
-                        )
-                        stalled = 0.0 if len(pending) < before else stalled + 5.0
-                    except Exception:
-                        break
-                for a in actors:
-                    try:
-                        api.kill(a)
-                    except Exception:
-                        pass
+        def on_start():
+            state["actors"] = [actor_cls.remote(blob) for _ in _range(n_actors)]
 
-        return run()
+        def submit(r):
+            a = state["actors"][state["rr"] % n_actors]
+            state["rr"] += 1
+            return a.apply.remote(r, op.batch_size, op.batch_format)
+
+        def on_end():
+            # In-flight applies (early exit) get a short grace before the
+            # kill so refs already handed downstream still resolve.
+            stream_op = state.get("op")
+            pending = list(stream_op.inflight) if stream_op is not None else []
+            stalled = 0.0
+            while pending and stalled < 60.0:
+                try:
+                    before = len(pending)
+                    _, pending = api.wait(pending, num_returns=len(pending), timeout=5)
+                    stalled = 0.0 if len(pending) < before else stalled + 5.0
+                except Exception:
+                    break
+            for a in state["actors"]:
+                try:
+                    api.kill(a)
+                except Exception:
+                    pass
+
+        sop = StreamOp(
+            f"map_batches[pool={n_actors}]",
+            submit,
+            cap=max(2, 2 * n_actors),
+            on_start=on_start,
+            on_end=on_end,
+        )
+        state["op"] = sop
+        return sop
 
     def _repartition(self, refs: List[Any], n: int) -> List[Any]:
         blocks = api.get(refs)

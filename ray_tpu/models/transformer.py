@@ -59,6 +59,19 @@ class TransformerConfig:
     # None: save nothing (lowest memory, recompute everything).
     remat_policy: Optional[str] = "dots"
     tie_embeddings: bool = False
+    # Architecture switches covering the GPT-J family (reference workload:
+    # release/air_examples/gptj_deepspeed_finetuning/): "gelu" MLP has no
+    # gate projection; parallel_block computes attention and MLP from ONE
+    # pre-norm and sums both into the residual (GPT-J's ln_1-only block).
+    mlp_act: str = "swiglu"  # "swiglu" | "gelu"
+    parallel_block: bool = False
+    # GPT-J applies RoPE to only the first rotary_dim dims of each head
+    # (64 of 256); None rotates the full head (llama). norm_type "layer"
+    # mean-centers before scaling (GPT-J's LayerNorm, bias unmodeled);
+    # "rms" is llama's RMSNorm.
+    rotary_dim: Optional[int] = None
+    norm_type: str = "rms"  # "rms" | "layer"
+    rope_style: str = "half"  # "half" (llama rotate-half) | "interleaved" (GPT-J)
 
     @property
     def head_dim(self) -> int:
@@ -79,11 +92,14 @@ def llama2_13b(**overrides) -> TransformerConfig:
 
 
 def gpt_j_6b(**overrides) -> TransformerConfig:
-    """GPT-J-6B-shaped config (the reference's DeepSpeed finetune workload,
-    reference: release/air_examples/gptj_deepspeed_finetuning/)."""
+    """GPT-J-6B config (the reference's DeepSpeed finetune workload,
+    reference: release/air_examples/gptj_deepspeed_finetuning/): gelu MLP
+    (no gate), parallel attention+MLP block. Biases are not modeled (the
+    HF loader folds what it can and documents the rest)."""
     return TransformerConfig(
         vocab_size=50400, d_model=4096, n_layers=28, n_heads=16, n_kv_heads=16,
-        d_ff=16384, rope_theta=10000.0,
+        d_ff=16384, rope_theta=10000.0, mlp_act="gelu", parallel_block=True,
+        rotary_dim=64, norm_type="layer", rope_style="interleaved",
     ).replace(**overrides)
 
 
@@ -122,11 +138,18 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> PyTree:
                 "wo": dense(next(k), (L, nh * hd, d), nh * hd),
             },
             "mlp_norm": {"scale": jnp.ones((L, d), cfg.dtype)},
-            "mlp": {
-                "w_gate": dense(next(k), (L, d, f), d),
-                "w_up": dense(next(k), (L, d, f), d),
-                "w_down": dense(next(k), (L, f, d), f),
-            },
+            "mlp": (
+                {
+                    "w_gate": dense(next(k), (L, d, f), d),
+                    "w_up": dense(next(k), (L, d, f), d),
+                    "w_down": dense(next(k), (L, f, d), f),
+                }
+                if cfg.mlp_act == "swiglu"
+                else {
+                    "w_up": dense(next(k), (L, d, f), d),
+                    "w_down": dense(next(k), (L, f, d), f),
+                }
+            ),
         },
         "final_norm": {"scale": jnp.ones((d,), cfg.dtype)},
     }
@@ -147,18 +170,49 @@ def rms_norm(x, scale, eps):
     return (x * lax.rsqrt(var + eps)).astype(x.dtype) * scale
 
 
+def layer_norm(x, scale, eps):
+    """Mean-centering LayerNorm, scale-only (GPT-J's ln, bias unmodeled)."""
+    xf = x.astype(jnp.float32)
+    xf = xf - jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + eps)).astype(x.dtype) * scale
+
+
+def _norm(x, scale, cfg: TransformerConfig):
+    if cfg.norm_type == "layer":
+        return layer_norm(x, scale, cfg.norm_eps)
+    return rms_norm(x, scale, cfg.norm_eps)
+
+
 def rope_tables(cfg: TransformerConfig, seq_len: int):
-    half = cfg.head_dim // 2
+    half = (cfg.rotary_dim or cfg.head_dim) // 2
     freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
     angles = jnp.arange(seq_len, dtype=jnp.float32)[:, None] * freqs[None, :]
-    return jnp.cos(angles), jnp.sin(angles)  # [seq, head_dim/2]
+    return jnp.cos(angles), jnp.sin(angles)  # [seq, rotary_dim/2]
 
 
-def apply_rope(x, cos, sin):
-    """x: [b, s, h, d]; rotate-half formulation."""
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+def _rotate(x, cos, sin, interleave: bool):
     c, s = cos[None, :, None, :], sin[None, :, None, :]
-    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+    if interleave:
+        # GPT-J convention: pairs are (even, odd) interleaved dims.
+        x1, x2 = x[..., ::2], x[..., 1::2]
+        o1, o2 = x1 * c - x2 * s, x2 * c + x1 * s
+        return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def apply_rope(x, cos, sin, cfg: Optional[TransformerConfig] = None):
+    """x: [b, s, h, d]. Llama rotates the full head (rotate-half); GPT-J
+    rotates only the first rotary_dim dims, interleaved pairs, leaving the
+    rest pass-through."""
+    rd = cfg.rotary_dim if cfg is not None else None
+    interleave = cfg is not None and cfg.rope_style == "interleaved"
+    xf = x.astype(jnp.float32)
+    if rd is not None and rd < x.shape[-1]:
+        rot = _rotate(xf[..., :rd], cos, sin, interleave)
+        return jnp.concatenate([rot, xf[..., rd:]], axis=-1).astype(x.dtype)
+    return _rotate(xf, cos, sin, interleave).astype(x.dtype)
 
 
 def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
@@ -211,28 +265,39 @@ def _layer(x, layer_params, cfg: TransformerConfig, cos, sin, mesh: Optional[Mes
     hd = cfg.head_dim
     ap, mp = layer_params["attn"], layer_params["mlp"]
 
-    h = rms_norm(x, layer_params["attn_norm"]["scale"], cfg.norm_eps)
+    h = _norm(x, layer_params["attn_norm"]["scale"], cfg)
     q = jnp.einsum("bsd,dk->bsk", h, ap["wq"], preferred_element_type=jnp.float32)
     k = jnp.einsum("bsd,dk->bsk", h, ap["wk"], preferred_element_type=jnp.float32)
     v = jnp.einsum("bsd,dk->bsk", h, ap["wv"], preferred_element_type=jnp.float32)
     q = q.reshape(b, s, cfg.n_heads, hd).astype(cfg.dtype)
     k = k.reshape(b, s, cfg.n_kv_heads, hd).astype(cfg.dtype)
     v = v.reshape(b, s, cfg.n_kv_heads, hd).astype(cfg.dtype)
-    q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+    q, k = apply_rope(q, cos, sin, cfg), apply_rope(k, cos, sin, cfg)
     o = _attention(q, k, v, cfg, mesh)
     o = o.reshape(b, s, cfg.n_heads * hd)
-    x = x + jnp.einsum("bsk,kd->bsd", o, ap["wo"], preferred_element_type=jnp.float32).astype(
-        cfg.dtype
-    )
+    attn_out = jnp.einsum(
+        "bsk,kd->bsd", o, ap["wo"], preferred_element_type=jnp.float32
+    ).astype(cfg.dtype)
 
-    h = rms_norm(x, layer_params["mlp_norm"]["scale"], cfg.norm_eps)
-    gate = jnp.einsum("bsd,df->bsf", h, mp["w_gate"], preferred_element_type=jnp.float32)
-    up = jnp.einsum("bsd,df->bsf", h, mp["w_up"], preferred_element_type=jnp.float32)
-    act = (jax.nn.silu(gate) * up).astype(cfg.dtype)
-    x = x + jnp.einsum(
+    # Parallel block (GPT-J): MLP reads the SAME pre-norm as attention and
+    # both sum into the residual; sequential (llama) re-norms after attn.
+    if cfg.parallel_block:
+        mlp_in = h
+    else:
+        x = x + attn_out
+        mlp_in = _norm(x, layer_params["mlp_norm"]["scale"], cfg)
+    up = jnp.einsum("bsd,df->bsf", mlp_in, mp["w_up"], preferred_element_type=jnp.float32)
+    if cfg.mlp_act == "swiglu":
+        gate = jnp.einsum(
+            "bsd,df->bsf", mlp_in, mp["w_gate"], preferred_element_type=jnp.float32
+        )
+        act = (jax.nn.silu(gate) * up).astype(cfg.dtype)
+    else:
+        act = jax.nn.gelu(up).astype(cfg.dtype)
+    mlp_out = jnp.einsum(
         "bsf,fd->bsd", act, mp["w_down"], preferred_element_type=jnp.float32
     ).astype(cfg.dtype)
-    return x
+    return x + attn_out + mlp_out if cfg.parallel_block else x + mlp_out
 
 
 def forward(
@@ -258,7 +323,7 @@ def forward(
         return body(x, layer_params), None
 
     x, _ = lax.scan(scan_step, x, params["blocks"])
-    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+    x = _norm(x, params["final_norm"]["scale"], cfg)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"]["embedding"].T
@@ -348,7 +413,7 @@ def forward_pipelined(
 
     y = pipeline_apply(stage_fn, stage_params, mb, mesh, axis=stage_axis, remat=cfg.remat)
     x = y.reshape(b, s, cfg.d_model)
-    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+    x = _norm(x, params["final_norm"]["scale"], cfg)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"]["embedding"].T

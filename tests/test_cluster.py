@@ -426,3 +426,39 @@ def test_broadcast_tree_replicates_to_all_nodes():
     finally:
         rt.shutdown()
         cluster.shutdown()
+
+
+def test_duplicate_submit_is_deduped(cluster_rt, tmp_path):
+    """A reconnect-resend duplicate of a one-way submit must not execute the
+    task twice (reference analogue: gRPC ack semantics make PushTask
+    exactly-once; here rpc.py notify() resends after reconnect, so the
+    raylet ingress dedupes on (task_id, attempt))."""
+    import ray_tpu as rt
+    from ray_tpu.core import runtime_base
+
+    runtime = runtime_base.current_runtime()
+    runtime._fastpath._disabled = True  # force the raylet submit path
+    raylet = runtime._raylet
+    orig_notify = raylet.notify
+    marker = tmp_path / "count.txt"
+
+    def double_notify(method, *a, **kw):
+        orig_notify(method, *a, **kw)
+        if method in ("submit_task", "submit_task_batch"):
+            orig_notify(method, *a, **kw)  # simulate the resend-after-reconnect
+
+    raylet.notify = double_notify
+
+    @rt.remote
+    def bump(path):
+        with open(path, "a") as f:
+            f.write("x")
+        return 1
+
+    try:
+        assert rt.get(bump.remote(str(marker)), timeout=60) == 1
+        time.sleep(1.0)  # a duplicate execution would land in this window
+    finally:
+        raylet.notify = orig_notify
+        runtime._fastpath._disabled = False
+    assert marker.read_text() == "x"

@@ -1,0 +1,92 @@
+"""Out-of-band collective groups between actor processes (reference:
+python/ray/util/collective/collective.py — init_collective_group /
+allreduce / broadcast / barrier between distinct processes, the NCCL/Gloo
+role; here a TCP ring over DCN, SURVEY.md §5 comm-backend)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+
+
+@pytest.fixture
+def rt_cluster():
+    rt.shutdown()
+    rt.init(num_cpus=4, num_workers=3)
+    yield rt
+    rt.shutdown()
+
+
+@rt.remote
+class Member:
+    def join(self, ws, rank, name):
+        from ray_tpu import collective
+
+        collective.init_collective_group(ws, rank, group_name=name)
+        self.rank = rank
+        return True
+
+    def do_allreduce(self, name):
+        from ray_tpu import collective
+
+        arr = np.full(1000, float(self.rank + 1), dtype=np.float64)
+        return collective.allreduce(arr, group_name=name)
+
+    def do_broadcast(self, name):
+        from ray_tpu import collective
+
+        arr = np.arange(16, dtype=np.int64) if self.rank == 0 else None
+        return collective.broadcast(arr, src_rank=0, group_name=name)
+
+    def do_allgather(self, name):
+        from ray_tpu import collective
+
+        return collective.allgather(
+            np.array([self.rank * 10], dtype=np.int64), group_name=name
+        )
+
+    def do_barrier_then_rank(self, name):
+        from ray_tpu import collective
+
+        collective.barrier(group_name=name)
+        return self.rank
+
+    def leave(self, name):
+        from ray_tpu import collective
+
+        collective.destroy_collective_group(name)
+        return True
+
+
+def test_two_process_collective_group(rt_cluster):
+    members = [Member.remote() for _ in range(2)]
+    rt.get(
+        [m.join.remote(2, i, "g2") for i, m in enumerate(members)], timeout=120
+    )
+    # allreduce: ranks contribute 1.0 and 2.0 per element -> 3.0 everywhere.
+    outs = rt.get([m.do_allreduce.remote("g2") for m in members], timeout=120)
+    for o in outs:
+        np.testing.assert_allclose(o, np.full(1000, 3.0))
+    # broadcast from rank 0.
+    outs = rt.get([m.do_broadcast.remote("g2") for m in members], timeout=120)
+    for o in outs:
+        np.testing.assert_array_equal(o, np.arange(16, dtype=np.int64))
+    # barrier completes.
+    assert sorted(
+        rt.get([m.do_barrier_then_rank.remote("g2") for m in members], timeout=120)
+    ) == [0, 1]
+    rt.get([m.leave.remote("g2") for m in members], timeout=60)
+
+
+def test_three_process_ring_allreduce_and_allgather(rt_cluster):
+    members = [Member.remote() for _ in range(3)]
+    rt.get(
+        [m.join.remote(3, i, "g3") for i, m in enumerate(members)], timeout=120
+    )
+    outs = rt.get([m.do_allreduce.remote("g3") for m in members], timeout=120)
+    for o in outs:  # 1 + 2 + 3
+        np.testing.assert_allclose(o, np.full(1000, 6.0))
+    gathered = rt.get([m.do_allgather.remote("g3") for m in members], timeout=120)
+    for g in gathered:
+        assert [int(x[0]) for x in g] == [0, 10, 20]
+    rt.get([m.leave.remote("g3") for m in members], timeout=60)

@@ -1,0 +1,104 @@
+"""HF safetensors ingestion onto sharded trees (reference:
+python/ray/train/huggingface/transformers/ + the GPT-J-6B finetune
+workload release/air_examples/gptj_deepspeed_finetuning/ — VERDICT r4
+item 5: load a tiny HF-format checkpoint into the sharded tree
+bit-exactly on the 8-device CPU mesh)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def mesh8():
+    import jax
+
+    from ray_tpu.parallel import build_mesh
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    devices = jax.devices("cpu")[:8]
+    return build_mesh(MeshSpec(data=2, fsdp=2, tensor=2), devices=devices)
+
+
+def _tree_equal(a, b):
+    import jax
+
+    fa, ta = jax.tree_util.tree_flatten_with_path(a)
+    fb = dict(jax.tree_util.tree_flatten_with_path(b)[0])
+    assert len(fa) == len(fb)
+    for path, leaf in fa:
+        other = fb[path]
+        np.testing.assert_array_equal(
+            np.asarray(leaf, dtype=np.float32), np.asarray(other, dtype=np.float32),
+            err_msg=f"mismatch at {path}",
+        )
+
+
+def test_safetensors_roundtrip_raw(tmp_path):
+    from ray_tpu.train.hf_checkpoint import SafetensorsFile, write_safetensors
+
+    tensors = {
+        "a": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "b": np.ones((2, 2), dtype=np.float16),
+    }
+    p = str(tmp_path / "t.safetensors")
+    write_safetensors(p, tensors)
+    f = SafetensorsFile(p)
+    assert sorted(f.keys()) == ["a", "b"]
+    np.testing.assert_array_equal(f.get("a"), tensors["a"])
+    np.testing.assert_array_equal(f.get("b"), tensors["b"])
+    f.close()
+
+
+def test_llama_checkpoint_bit_exact_on_mesh(tmp_path, mesh8):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.train.hf_checkpoint import export_hf_checkpoint, load_hf_checkpoint
+
+    cfg = tfm.tiny(dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(7), cfg)
+    ckpt = str(tmp_path / "model.safetensors")
+    export_hf_checkpoint(params, cfg, ckpt, family="llama")
+
+    loaded = load_hf_checkpoint(ckpt, cfg, family="llama", mesh=mesh8)
+    _tree_equal(params, loaded)
+    # Leaves are actually sharded over the mesh (not single-device).
+    wq = loaded["blocks"]["attn"]["wq"]
+    assert len(wq.sharding.device_set) > 1
+    # The loaded tree runs: forward under the mesh produces finite logits.
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = tfm.forward(loaded, tokens, cfg)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_gptj_family_load_and_forward(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.train.hf_checkpoint import export_hf_checkpoint, load_hf_checkpoint
+
+    cfg = tfm.tiny(dtype=jnp.float32, mlp_act="gelu", parallel_block=True,
+                   n_kv_heads=4)
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg)
+    assert "w_gate" not in params["blocks"]["mlp"]  # gelu MLP has no gate
+    ckpt = str(tmp_path / "gptj.safetensors")
+    export_hf_checkpoint(params, cfg, ckpt, family="gptj")
+    loaded = load_hf_checkpoint(ckpt, cfg, family="gptj")
+    _tree_equal(params, loaded)
+    logits = tfm.forward(loaded, jnp.zeros((1, 8), jnp.int32), cfg)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_missing_tensor_is_reported(tmp_path):
+    import jax.numpy as jnp
+
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.train.hf_checkpoint import load_hf_checkpoint, write_safetensors
+
+    cfg = tfm.tiny(dtype=jnp.float32)
+    p = str(tmp_path / "partial.safetensors")
+    write_safetensors(p, {"model.embed_tokens.weight": np.zeros((cfg.vocab_size, cfg.d_model), np.float32)})
+    with pytest.raises(KeyError, match="missing tensors"):
+        load_hf_checkpoint(p, cfg, family="llama")
