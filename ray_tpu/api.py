@@ -174,15 +174,21 @@ def _build_sched_options(opts: Dict[str, Any], for_actor: bool = False) -> Sched
         raise ValueError(f"invalid option(s) {sorted(bad)}; valid: {sorted(_VALID_OPTIONS)}")
     renv = opts.get("runtime_env")
     if renv:
-        supported = {"env_vars", "working_dir", "py_modules", "pip"}
+        from .core.runtime_env import _load_external_plugins, _PLUGINS
+
+        _load_external_plugins()
+        supported = set(_PLUGINS)  # builtin + registered/env-loaded plugins
         bad_env = set(renv) - supported
         if bad_env:
             # Honest surface: unsupported runtime-env fields raise instead
             # of being silently dropped (reference: runtime_env validation,
             # python/ray/_private/runtime_env/validation.py).
             raise ValueError(
-                f"runtime_env field(s) {sorted(bad_env)} are not supported; "
-                f"supported: {sorted(supported)}"
+                f"runtime_env field(s) {sorted(bad_env)} have no plugin "
+                f"registered in this driver process; supported: "
+                f"{sorted(supported)}. Custom plugins must be registered "
+                "here too (register_plugin, or RAY_TPU_RUNTIME_ENV_PLUGINS "
+                "exported before the driver starts)."
             )
         ev = renv.get("env_vars")
         if ev is not None and (

@@ -49,8 +49,14 @@ def _entry_from_spec(spec: TaskSpec) -> dict:
     if spec.task_type == TaskType.NORMAL_TASK and not resources:
         resources = {"CPU": 1.0}
     streaming = spec.num_returns == "streaming"
+    from .. import tracing as _tracing
+
     return {
         "task_id": spec.task_id.hex(),
+        # Span context propagation (reference: tracing_helper.py:165 —
+        # context injected into the spec so the executor's span parents
+        # to the submitter's ambient span). None when tracing is off.
+        "trace_ctx": _tracing.current_context(),
         "func_blob": spec.func_blob,
         "func_hash": spec.func_hash,
         "method_name": spec.method_name,
@@ -583,9 +589,14 @@ class ClusterRuntime(Runtime):
             for h in entry["return_ids"]:
                 self._records[h] = rec
                 self._owned.add(h)
-                # The spec ships the return ids to the executing worker,
-                # which may register a borrow: never eager-free them.
-                self._escaped.add(h)
+                # Return ids are NOT eagerly escaped: every path that hands
+                # this ref to another process (arg conversion, __reduce__,
+                # broadcast) goes through owner-side mark_escaped, which
+                # promotes a memstore blob to shm under _ref_lock before
+                # the ref leaves. Eager escape here would route every
+                # inline result through shm + a directory notify — ~2x the
+                # per-task cost of the owner memstore path the inline ack
+                # exists for (measured: 6.9k/s -> 9k/s async tasks).
             # Lineage-pin the arguments: they stay alive (and reconstructable)
             # while any output of this task is still referenced.
             for dep in entry.get("deps", []):

@@ -183,6 +183,7 @@ def task_frame(entry: dict, conn: DirectConn) -> tuple:
         entry["return_ids"],
         entry.get("desc", ""),
         bool(entry.get("streaming")),
+        entry.get("trace_ctx"),
     )
 
 
@@ -197,7 +198,11 @@ def actor_frame(entry: dict) -> tuple:
         entry.get("desc", ""),
         bool(entry.get("streaming")),
         entry.get("concurrency_group"),
+        entry.get("trace_ctx"),
     )
+
+
+from .ids import ObjectID as _ObjectID
 
 
 def _eligible(entry: dict, store) -> bool:
@@ -215,9 +220,7 @@ def _eligible(entry: dict, store) -> bool:
     if res and res != {"CPU": 1.0}:
         return False
     for dep in entry.get("deps", ()):
-        from .ids import ObjectID
-
-        if not store.contains(ObjectID.from_hex(dep)):
+        if not store.contains(_ObjectID.from_hex(dep)):
             return False
     return True
 
@@ -232,6 +235,7 @@ class FastPath:
         self._conns: List[DirectConn] = []
         self._rr = 0
         self._rate_mark = None  # (acked_total, t) for drain-rate estimate
+        self._scale_tick = 0
         self._requesting = False
         self._cooldown_until = 0.0
         self._closed = False
@@ -284,15 +288,33 @@ class FastPath:
             return False  # lease died mid-send: slow path takes this one
         conn.sent_hashes.add(entry["func_hash"])
         entry["_fast"] = conn.worker_id
-        self._maybe_scale()
+        # Scale checks sum queue depths under the lock — amortize to every
+        # 32nd submit (it's a heuristic; 31-task lag is noise next to
+        # SCALE_BACKLOG) so the hot path is two socket writes + a pickle.
+        self._scale_tick += 1
+        if not (self._scale_tick & 31):
+            self._maybe_scale()
         return True
 
     def _pick_conn(self) -> Optional[DirectConn]:
+        # Hot path: round-robin over a snapshot without rebuilding the
+        # list per task; prune dead/draining conns only when one is seen.
+        # The cursor is read once and used modulo the SNAPSHOT length — a
+        # concurrent submitter bumping self._rr against a longer list must
+        # not index past this thread's snapshot.
+        conns = self._conns
+        n = len(conns)
+        rr = self._rr + 1
+        self._rr = rr  # benign race: approximate round-robin is fine
+        for i in range(n):
+            c = conns[(rr + i) % n]
+            if c.alive and not c.draining:
+                return c
         with self._lock:
             self._conns = [c for c in self._conns if c.alive and not c.draining]
             if self._conns:
-                self._rr = (self._rr + 1) % len(self._conns)
-                return self._conns[self._rr]
+                self._rr = 0
+                return self._conns[0]
             self._spawn_acquire_locked()
             return None
 

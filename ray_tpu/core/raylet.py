@@ -1691,18 +1691,37 @@ class RayletService:
         log_base = os.path.join(self._log_dir, f"worker_{worker_id}")
         out_f = open(log_base + ".out", "ab", buffering=0)
         err_f = open(log_base + ".err", "ab", buffering=0)
+        argv = [
+            py_exe,
+            "-m",
+            "ray_tpu.core.worker_proc",
+            self.sock_path,
+            self.store_path,
+            self.gcs_sock,
+            worker_id,
+            self.node_id,
+        ]
+        # Container plugin (image_uri): the whole worker command runs
+        # inside `podman run ...` (reference: image_uri.py wrapping the
+        # worker command; runtime_env.ImageUriPlugin builds the prefix).
+        prefix = (renv or {}).get("_command_prefix")
+        if prefix:
+            from .runtime_env import ImageUriPlugin
+
+            expanded: List[str] = []
+            for part in prefix:
+                if part == ImageUriPlugin.ENV_ARGS_SENTINEL:
+                    # Forward every env var this spawn ADDED beyond the
+                    # inherited process env (docker has no --env-host).
+                    for k, v in env.items():
+                        if os.environ.get(k) != v:
+                            expanded += ["--env", f"{k}={v}"]
+                else:
+                    expanded.append(part)
+            argv = expanded + argv
         try:
             proc = subprocess.Popen(
-                [
-                    py_exe,
-                    "-m",
-                    "ray_tpu.core.worker_proc",
-                    self.sock_path,
-                    self.store_path,
-                    self.gcs_sock,
-                    worker_id,
-                    self.node_id,
-                ],
+                argv,
                 env=env,
                 stdout=out_f,
                 stderr=err_f,

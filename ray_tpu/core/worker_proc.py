@@ -346,33 +346,43 @@ def main(argv: List[str]) -> None:
         """Executes one entry body synchronously (any thread)."""
         from .runtime_context import reset_task_context, set_task_context
 
+        from .. import tracing as _tracing
+
         kind = entry["type"]
         token = set_task_context(entry.get("task_id"), entry.get("actor_id"))
         try:
-            if kind == "task":
-                fn = GLOBAL_FUNCTION_TABLE.loads(entry["func_blob"], entry["func_hash"])
-                args, kwargs = _resolve_args(store, entry["args_blob"], raylet)
-                result = fn(*args, **kwargs)
-                if inspect.iscoroutine(result):
-                    import asyncio
+            # Execution span parented to the submitter's span via the
+            # propagated context (reference: tracing_helper.py:92 —
+            # _span_wrapper around task execution).
+            with _tracing.continue_context(
+                entry.get("trace_ctx"),
+                f"run {entry.get('desc') or kind}",
+                {"task_id": entry.get("task_id", "")},
+            ):
+                if kind == "task":
+                    fn = GLOBAL_FUNCTION_TABLE.loads(entry["func_blob"], entry["func_hash"])
+                    args, kwargs = _resolve_args(store, entry["args_blob"], raylet)
+                    result = fn(*args, **kwargs)
+                    if inspect.iscoroutine(result):
+                        import asyncio
 
-                    result = asyncio.run(result)
-                store_returns(entry, result, sealed)
-                return True
-            if kind == "actor_task":
-                inst = actor_instance.get(entry["actor_id"])
-                if inst is None:
-                    raise RuntimeError("actor instance missing in worker")
-                method = bind_method(inst, entry["method_name"])
-                args, kwargs = _resolve_args(store, entry["args_blob"], raylet)
-                result = method(*args, **kwargs)
-                if inspect.iscoroutine(result):
-                    import asyncio
+                        result = asyncio.run(result)
+                    store_returns(entry, result, sealed)
+                    return True
+                if kind == "actor_task":
+                    inst = actor_instance.get(entry["actor_id"])
+                    if inst is None:
+                        raise RuntimeError("actor instance missing in worker")
+                    method = bind_method(inst, entry["method_name"])
+                    args, kwargs = _resolve_args(store, entry["args_blob"], raylet)
+                    result = method(*args, **kwargs)
+                    if inspect.iscoroutine(result):
+                        import asyncio
 
-                    result = asyncio.run(result)
-                store_returns(entry, result, sealed)
+                        result = asyncio.run(result)
+                    store_returns(entry, result, sealed)
+                    return True
                 return True
-            return True
         except SystemExit:
             store_returns(entry, None, sealed)
             raise
@@ -630,10 +640,11 @@ def main(argv: List[str]) -> None:
                 if kind == "t":
                     # Leased normal task: the main thread executes it (keeps
                     # SIGINT cancellation + serial semantics).
-                    _, tid, fh, fb, ab, rids, desc, streaming = frame
+                    _, tid, fh, fb, ab, rids, desc, streaming = frame[:8]
                     entry = {
                         "type": "task",
                         "task_id": tid,
+                        "trace_ctx": frame[8] if len(frame) > 8 else None,
                         "func_hash": fh,
                         "func_blob": fb,
                         "args_blob": ab,
@@ -646,10 +657,11 @@ def main(argv: List[str]) -> None:
                         entry["_stream_report"] = _make_stream_report(send_raw)
                     direct_inbox.put((entry, send_done))
                 elif kind == "a":
-                    _, tid, aid, method, ab, rids, desc, streaming, cgroup = frame
+                    _, tid, aid, method, ab, rids, desc, streaming, cgroup = frame[:9]
                     entry = {
                         "type": "actor_task",
                         "task_id": tid,
+                        "trace_ctx": frame[9] if len(frame) > 9 else None,
                         "actor_id": aid,
                         "method_name": method,
                         "args_blob": ab,

@@ -165,6 +165,35 @@ def param_count(params: PyTree) -> int:
 # ------------------------------------------------------------------ layers
 
 
+def _ckpt(val, name: str):
+    """Tags a value for remat_policy="hot" (save_only_these_names): names
+    mark the SAVED residual frontier; everything unnamed rematerializes.
+    Exclusion-style policies cannot work here — checkpoint_name is an
+    identity op, so "excluding" a named value just makes the partitioner
+    save its unnamed producer instead (same bytes). Inclusion is the only
+    reliable way to pin a bf16 save frontier."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    return checkpoint_name(val, name)
+
+
+# The save frontier for remat_policy="hot": small bf16 per-layer tensors
+# (q/k/v post-rope, attention out, MLP input, MLP activation) + the flash
+# kernel's o/lse (named in ops/flash_attention.py). Backward recomputes
+# only the norms, rope on nothing (q/k/v are saved post-rope), and the
+# gate/up MLP dots (~10% extra layer FLOPs) instead of the whole layer.
+HOT_SAVE_NAMES = (
+    "flash_o",
+    "flash_lse",
+    "q_bf16",
+    "k_bf16",
+    "v_bf16",
+    "attn_out_bf16",
+    "mlp_in_bf16",
+    "mlp_act_bf16",
+)
+
+
 def rms_norm(x, scale, eps):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * lax.rsqrt(var + eps)).astype(x.dtype) * scale
@@ -211,8 +240,10 @@ def apply_rope(x, cos, sin, cfg: Optional[TransformerConfig] = None):
     xf = x.astype(jnp.float32)
     if rd is not None and rd < x.shape[-1]:
         rot = _rotate(xf[..., :rd], cos, sin, interleave)
-        return jnp.concatenate([rot, xf[..., rd:]], axis=-1).astype(x.dtype)
-    return _rotate(xf, cos, sin, interleave).astype(x.dtype)
+        out = jnp.concatenate([rot, xf[..., rd:]], axis=-1)
+    else:
+        out = _rotate(xf, cos, sin, interleave)
+    return out.astype(x.dtype)
 
 
 def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
@@ -272,12 +303,17 @@ def _layer(x, layer_params, cfg: TransformerConfig, cos, sin, mesh: Optional[Mes
     q = q.reshape(b, s, cfg.n_heads, hd).astype(cfg.dtype)
     k = k.reshape(b, s, cfg.n_kv_heads, hd).astype(cfg.dtype)
     v = v.reshape(b, s, cfg.n_kv_heads, hd).astype(cfg.dtype)
-    q, k = apply_rope(q, cos, sin, cfg), apply_rope(k, cos, sin, cfg)
+    q = _ckpt(apply_rope(q, cos, sin, cfg), "q_bf16")
+    k = _ckpt(apply_rope(k, cos, sin, cfg), "k_bf16")
+    v = _ckpt(v, "v_bf16")
     o = _attention(q, k, v, cfg, mesh)
     o = o.reshape(b, s, cfg.n_heads * hd)
-    attn_out = jnp.einsum(
-        "bsk,kd->bsd", o, ap["wo"], preferred_element_type=jnp.float32
-    ).astype(cfg.dtype)
+    attn_out = _ckpt(
+        jnp.einsum(
+            "bsk,kd->bsd", o, ap["wo"], preferred_element_type=jnp.float32
+        ).astype(cfg.dtype),
+        "attn_out_bf16",
+    )
 
     # Parallel block (GPT-J): MLP reads the SAME pre-norm as attention and
     # both sum into the residual; sequential (llama) re-norms after attn.
@@ -286,7 +322,10 @@ def _layer(x, layer_params, cfg: TransformerConfig, cos, sin, mesh: Optional[Mes
     else:
         x = x + attn_out
         mlp_in = _norm(x, layer_params["mlp_norm"]["scale"], cfg)
-    up = jnp.einsum("bsd,df->bsf", mlp_in, mp["w_up"], preferred_element_type=jnp.float32)
+    mlp_in = _ckpt(mlp_in, "mlp_in_bf16")
+    up = jnp.einsum(
+        "bsd,df->bsf", mlp_in, mp["w_up"], preferred_element_type=jnp.float32
+    )
     if cfg.mlp_act == "swiglu":
         gate = jnp.einsum(
             "bsd,df->bsf", mlp_in, mp["w_gate"], preferred_element_type=jnp.float32
@@ -294,10 +333,55 @@ def _layer(x, layer_params, cfg: TransformerConfig, cos, sin, mesh: Optional[Mes
         act = (jax.nn.silu(gate) * up).astype(cfg.dtype)
     else:
         act = jax.nn.gelu(up).astype(cfg.dtype)
+    act = _ckpt(act, "mlp_act_bf16")
     mlp_out = jnp.einsum(
         "bsf,fd->bsd", act, mp["w_down"], preferred_element_type=jnp.float32
     ).astype(cfg.dtype)
     return x + attn_out + mlp_out if cfg.parallel_block else x + mlp_out
+
+
+def forward_hidden(
+    params: PyTree,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """tokens [batch, seq] -> final-norm hidden states [batch, seq, d]."""
+    b, s = tokens.shape
+    cos, sin = rope_tables(cfg, s)
+    x = jnp.take(params["embed"]["embedding"], tokens, axis=0)
+
+    body = partial(_layer, cfg=cfg, cos=cos, sin=sin, mesh=mesh)
+    if cfg.remat:
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        elif cfg.remat_policy == "attn":
+            # Save ONLY the flash kernel's o+lse (named in its vjp fwd):
+            # the attention forward — the most expensive recompute under
+            # full remat — never re-runs in bwd, while the cheap qkv
+            # projections still rematerialize. ~16 MB/layer saved vs ~1/4
+            # of attention wall time recovered (measured r5).
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "flash_o", "flash_lse"
+            )
+        elif cfg.remat_policy == "hot":
+            # Selective remat (measured best on v5e, r5): save ONLY the
+            # named bf16 frontier (HOT_SAVE_NAMES, ~176 MB/layer at bench
+            # shapes vs ~2 GB/layer of fp32 saveables) — the bwd then
+            # recomputes just the norms and the gate/up MLP dots (~10%
+            # extra layer FLOPs) instead of the whole layer (~33%).
+            policy = jax.checkpoint_policies.save_only_these_names(
+                *HOT_SAVE_NAMES
+            )
+        else:
+            policy = None
+        body = jax.checkpoint(body, policy=policy)
+
+    def scan_step(x, layer_params):
+        return body(x, layer_params), None
+
+    x, _ = lax.scan(scan_step, x, params["blocks"])
+    return _norm(x, params["final_norm"]["scale"], cfg)
 
 
 def forward(
@@ -307,23 +391,7 @@ def forward(
     mesh: Optional[Mesh] = None,
 ) -> jax.Array:
     """tokens [batch, seq] int32 -> logits [batch, seq, vocab] float32."""
-    b, s = tokens.shape
-    cos, sin = rope_tables(cfg, s)
-    x = jnp.take(params["embed"]["embedding"], tokens, axis=0)
-
-    body = partial(_layer, cfg=cfg, cos=cos, sin=sin, mesh=mesh)
-    if cfg.remat:
-        if cfg.remat_policy == "dots":
-            policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
-        else:
-            policy = None
-        body = jax.checkpoint(body, policy=policy)
-
-    def scan_step(x, layer_params):
-        return body(x, layer_params), None
-
-    x, _ = lax.scan(scan_step, x, params["blocks"])
-    x = _norm(x, params["final_norm"]["scale"], cfg)
+    x = forward_hidden(params, tokens, cfg, mesh)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"]["embedding"].T

@@ -255,6 +255,14 @@ def _scratch(shape, dtype):
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret, heads):
     o, lse = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret, heads)
+    # Named for remat policies: saving o+lse (~16 MB/layer at bench shapes)
+    # lets jax.checkpoint skip re-running the forward kernel during the
+    # backward pass — the bwd kernels need only q,k,v (cheap projection
+    # recompute), do, lse, delta. See TransformerConfig.remat_policy="attn".
+    from jax.ad_checkpoint import checkpoint_name
+
+    o = checkpoint_name(o, "flash_o")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse)
 
 

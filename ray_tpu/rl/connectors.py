@@ -91,11 +91,21 @@ class ConnectorPipeline(Connector):
     """Left-to-right composition (reference: ConnectorPipelineV2)."""
 
     def __init__(self, connectors: List[Connector]):
-        self.connectors = list(connectors)
+        import inspect
 
-    def __call__(self, obs: np.ndarray) -> np.ndarray:
-        for c in self.connectors:
-            obs = c(obs)
+        self.connectors = list(connectors)
+        # Probed once: signature inspection is too slow for the per-step
+        # sampling hot path.
+        self._takes_dones = [
+            "dones" in inspect.signature(c.__call__).parameters
+            for c in self.connectors
+        ]
+
+    def __call__(self, obs: np.ndarray, dones: Optional[np.ndarray] = None) -> np.ndarray:
+        for c, takes in zip(self.connectors, self._takes_dones):
+            # Stateful connectors (FrameStack) take the episode-boundary
+            # signal; stateless ones keep the 1-arg signature.
+            obs = c(obs, dones=dones) if takes else c(obs)
         return obs
 
     def get_state(self) -> Dict[str, Any]:
@@ -105,3 +115,78 @@ class ConnectorPipeline(Connector):
         for i, c in enumerate(self.connectors):
             if i in state:
                 c.set_state(state[i])
+
+
+class FrameStack(Connector):
+    """Stacks the last k observations along the feature axis — the classic
+    Atari/velocity-from-position transform (reference: the frame-stacking
+    env-to-module connector). Stateful per vector-env slot; a done resets
+    that slot's stack (the runner passes `dones` from the previous step)."""
+
+    def __init__(self, k: int = 4):
+        self.k = k
+        self._stack: Optional[np.ndarray] = None  # [N, k, feat]
+
+    def __call__(self, obs: np.ndarray, dones: Optional[np.ndarray] = None) -> np.ndarray:
+        obs = np.asarray(obs, np.float32)
+        obs = obs.reshape(obs.shape[0], -1)
+        n, feat = obs.shape
+        if self._stack is None or self._stack.shape[0] != n or self._stack.shape[2] != feat:
+            self._stack = np.zeros((n, self.k, feat), np.float32)
+            self._stack[:] = obs[:, None, :]  # cold start: repeat first frame
+        elif dones is not None and dones.any():
+            idx = np.nonzero(dones)[0]
+            self._stack[idx] = obs[idx, None, :]
+        self._stack = np.roll(self._stack, shift=-1, axis=1)
+        self._stack[:, -1] = obs
+        return self._stack.reshape(n, self.k * feat)
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"k": self.k, "stack": self._stack}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.k = state["k"]
+        self._stack = state["stack"]
+
+
+# ----------------------------------------------------- module-to-env side
+
+
+class ActionConnector:
+    """One module-to-env transform on the ACTION path (reference:
+    connectors/module_to_env/ pipelines — the other half of ConnectorV2).
+    The buffer keeps the module's raw action (so (action, logp) stay
+    consistent); only the env sees the transformed one."""
+
+    def __call__(self, action: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+class ClipAction(ActionConnector):
+    def __init__(self, low, high):
+        self.low, self.high = np.asarray(low), np.asarray(high)
+
+    def __call__(self, action: np.ndarray) -> np.ndarray:
+        return np.clip(action, self.low, self.high)
+
+
+class UnsquashAction(ActionConnector):
+    """Maps a tanh-squashed [-1, 1] module action onto the env's bounds
+    (reference: module_to_env normalize/unsquash connector)."""
+
+    def __init__(self, low, high):
+        self.low, self.high = np.asarray(low, np.float32), np.asarray(high, np.float32)
+
+    def __call__(self, action: np.ndarray) -> np.ndarray:
+        a = np.clip(np.asarray(action, np.float32), -1.0, 1.0)
+        return self.low + (a + 1.0) * 0.5 * (self.high - self.low)
+
+
+class ActionPipeline(ActionConnector):
+    def __init__(self, connectors: List[ActionConnector]):
+        self.connectors = list(connectors)
+
+    def __call__(self, action: np.ndarray) -> np.ndarray:
+        for c in self.connectors:
+            action = c(action)
+        return action

@@ -29,6 +29,7 @@ class SingleAgentEnvRunner:
         num_envs: int,
         seed: int = 0,
         connector_blob: bytes = b"",
+        action_connector_blob: bytes = b"",
     ):
         import cloudpickle
         import gymnasium as gym
@@ -41,6 +42,21 @@ class SingleAgentEnvRunner:
         # applied to every observation; the buffer stores the TRANSFORMED
         # obs so training sees what the policy saw.
         self.connector = cloudpickle.loads(connector_blob) if connector_blob else None
+        # Signature probed ONCE (not per step, and no catch-retry: a
+        # TypeError from inside a partially-run stateful pipeline must
+        # surface, not re-roll FrameStack).
+        import inspect as _inspect
+
+        self._connector_takes_dones = bool(
+            self.connector is not None
+            and "dones" in _inspect.signature(self.connector.__call__).parameters
+        )
+        # module-to-env action pipeline (reference: connectors/module_to_env/):
+        # transforms the module's raw action for the env; the buffer keeps
+        # the raw action so (action, logp) stay consistent.
+        self.action_connector = (
+            cloudpickle.loads(action_connector_blob) if action_connector_blob else None
+        )
         self.num_envs = num_envs
         self._key = jax.random.PRNGKey(seed)
         self._params = None
@@ -58,11 +74,15 @@ class SingleAgentEnvRunner:
         # exploration state (e.g. epsilon) can ride the weight sync.
         self._sample = jax.jit(lambda params, key, out: self.module.sample_with_params(params, key, out))
 
-    def _flatten(self, obs: np.ndarray) -> np.ndarray:
+    def _flatten(self, obs: np.ndarray, dones=None) -> np.ndarray:
         """Default env-to-module transform: flatten to the MLP layout; a
         configured connector pipeline replaces it."""
         if self.connector is not None:
-            return np.asarray(self.connector(np.asarray(obs)), np.float32)
+            if self._connector_takes_dones:
+                out = self.connector(np.asarray(obs), dones=dones)
+            else:
+                out = self.connector(np.asarray(obs))
+            return np.asarray(out, np.float32)
         return np.asarray(obs, np.float32).reshape(obs.shape[0], -1)
 
     def set_weights(self, params) -> bool:
@@ -103,10 +123,17 @@ class SingleAgentEnvRunner:
             mask_buf[t] = 1.0 - self._prev_done
             # Bounds apply only at the env interface; the buffer keeps the
             # unclipped action so (action, logp) stay consistent.
-            env_action = np.asarray(self.module.clip_action(action))
+            if self.action_connector is not None:
+                env_action = np.asarray(self.action_connector(action))
+            else:
+                env_action = np.asarray(self.module.clip_action(action))
             obs, rew, terminated, truncated, _ = self.envs.step(env_action)
-            obs = self._flatten(obs)
             done = np.logical_or(terminated, truncated)
+            # NEXT_STEP autoreset: the obs returned by THIS step is the new
+            # episode's reset obs iff the PREVIOUS step finished — so the
+            # stack-reset signal is prev_done, not this step's done (a done
+            # step still returns the ending episode's final obs).
+            obs = self._flatten(obs, dones=self._prev_done.astype(bool))
             rew_buf[t] = rew
             term_buf[t] = terminated
             done_buf[t] = done
@@ -177,12 +204,16 @@ class EnvRunnerGroup:
         num_envs_per_runner: int = 4,
         seed: int = 0,
         connector=None,
+        action_connector=None,
     ):
         import cloudpickle
 
         self._env_name = env_name
         self._module_blob = cloudpickle.dumps(module)
         self._connector_blob = cloudpickle.dumps(connector) if connector else b""
+        self._action_connector_blob = (
+            cloudpickle.dumps(action_connector) if action_connector else b""
+        )
         self._num_envs = num_envs_per_runner
         self._seed = seed
         self._restarts = 0
@@ -200,6 +231,7 @@ class EnvRunnerGroup:
             self._num_envs,
             self._seed + 1000 * idx,
             self._connector_blob,
+            self._action_connector_blob,
         )
         if self._last_weights_ref is not None:
             api.get(runner.set_weights.remote(self._last_weights_ref))

@@ -1,0 +1,196 @@
+"""Distributed tracing: spans around submit/execute with context propagation.
+
+Re-design of the reference's OpenTelemetry integration (reference:
+python/ray/util/tracing/tracing_helper.py:34 _OpenTelemetryProxy, :92
+span-injecting decorators around task submission, :165 context carried
+inside task specs so worker-side spans parent to the submitting span).
+The TPU build keeps the same shape without requiring the opentelemetry
+package: spans are plain dicts `{trace_id, span_id, parent_id, name,
+start_us, end_us, attrs}`, the ambient context rides a contextvar, task
+entries carry `trace_ctx`, and exporters are pluggable — the default
+writes JSONL under the session dir so spans from every process (driver,
+raylets' workers) merge by trace_id. `collect()` reassembles the tree.
+
+Opt-in: `RAY_TPU_TRACING=1` (inherited by daemons/workers) or
+`tracing.enable(exporter)` in-process.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_ctx: "contextvars.ContextVar[Optional[dict]]" = contextvars.ContextVar(
+    "ray_tpu_trace_ctx", default=None
+)
+
+_lock = threading.Lock()
+_exporter: Optional["SpanExporter"] = None
+_enabled_env = os.environ.get("RAY_TPU_TRACING") == "1"
+
+
+class SpanExporter:
+    def export(self, span: dict) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class InMemoryExporter(SpanExporter):
+    def __init__(self):
+        self.spans: List[dict] = []
+
+    def export(self, span: dict) -> None:
+        self.spans.append(span)
+
+
+class JsonlExporter(SpanExporter):
+    """One JSONL file per process under <dir>/; `collect()` merges them."""
+
+    def __init__(self, directory: str):
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, f"spans_{os.getpid()}.jsonl")
+        self._f = open(self.path, "a", buffering=1)
+        self._flock = threading.Lock()
+
+    def export(self, span: dict) -> None:
+        with self._flock:
+            self._f.write(json.dumps(span) + "\n")
+
+    def shutdown(self) -> None:
+        with contextlib.suppress(Exception):
+            self._f.close()
+
+
+def enable(exporter: Optional[SpanExporter] = None) -> None:
+    """Turns tracing on in THIS process. Without an exporter, spans go to
+    JSONL under $RAY_TPU_TRACE_DIR (or the tmp default)."""
+    global _exporter
+    with _lock:
+        if exporter is None:
+            exporter = JsonlExporter(trace_dir())
+        _exporter = exporter
+
+
+def disable() -> None:
+    global _exporter
+    with _lock:
+        if _exporter is not None:
+            _exporter.shutdown()
+        _exporter = None
+
+
+def trace_dir() -> str:
+    import tempfile
+
+    return os.environ.get("RAY_TPU_TRACE_DIR") or os.path.join(
+        tempfile.gettempdir(), "ray_tpu_traces"
+    )
+
+
+def _active() -> Optional[SpanExporter]:
+    global _exporter
+    if _exporter is not None:
+        return _exporter
+    if _enabled_env or os.environ.get("RAY_TPU_TRACING") == "1":
+        # Daemons/workers inherit the env toggle; lazy-init the JSONL sink.
+        with _lock:
+            if _exporter is None:
+                _exporter = JsonlExporter(trace_dir())
+        return _exporter
+    return None
+
+
+def is_enabled() -> bool:
+    return _active() is not None
+
+
+# ----------------------------------------------------------------- spans
+@contextlib.contextmanager
+def span(name: str, attrs: Optional[Dict[str, Any]] = None):
+    """Opens a span under the ambient context; sets itself as ambient for
+    the duration (children parent to it — including spans created in
+    OTHER processes via the propagated trace_ctx)."""
+    exp = _active()
+    if exp is None:
+        yield None
+        return
+    parent = _ctx.get()
+    sp = {
+        "trace_id": parent["trace_id"] if parent else uuid.uuid4().hex,
+        "span_id": uuid.uuid4().hex[:16],
+        "parent_id": parent["span_id"] if parent else None,
+        "name": name,
+        "pid": os.getpid(),
+        "start_us": int(time.time() * 1e6),
+        "attrs": attrs or {},
+    }
+    token = _ctx.set({"trace_id": sp["trace_id"], "span_id": sp["span_id"]})
+    try:
+        yield sp
+    except BaseException as e:
+        sp["attrs"]["error"] = repr(e)
+        raise
+    finally:
+        _ctx.reset(token)
+        sp["end_us"] = int(time.time() * 1e6)
+        exp.export(sp)
+
+
+def current_context() -> Optional[dict]:
+    """The ambient {trace_id, span_id} to inject into an outgoing task
+    entry (reference: tracing_helper.py:165 _inject_tracing_into_function)."""
+    if not is_enabled():
+        return None
+    return _ctx.get()
+
+
+@contextlib.contextmanager
+def continue_context(trace_ctx: Optional[dict], name: str, attrs=None):
+    """Worker side: re-roots the ambient context from a propagated
+    trace_ctx, then opens an execution span under it."""
+    if trace_ctx and is_enabled():
+        token = _ctx.set(trace_ctx)
+        try:
+            with span(name, attrs) as sp:
+                yield sp
+        finally:
+            _ctx.reset(token)
+    else:
+        with span(name, attrs) as sp:
+            yield sp
+
+
+# ------------------------------------------------------------- collection
+def collect(directory: Optional[str] = None) -> List[dict]:
+    """Merges every process's JSONL spans (sorted by start time)."""
+    directory = directory or trace_dir()
+    spans: List[dict] = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return spans
+    for fname in names:
+        if not fname.endswith(".jsonl"):
+            continue
+        with open(os.path.join(directory, fname)) as f:
+            for line in f:
+                with contextlib.suppress(json.JSONDecodeError):
+                    spans.append(json.loads(line))
+    spans.sort(key=lambda s: s.get("start_us", 0))
+    return spans
+
+
+def span_tree(spans: List[dict]) -> Dict[Optional[str], List[dict]]:
+    """Groups spans by parent_id for tree walks in tests/tools."""
+    by_parent: Dict[Optional[str], List[dict]] = {}
+    for s in spans:
+        by_parent.setdefault(s.get("parent_id"), []).append(s)
+    return by_parent

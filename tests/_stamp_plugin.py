@@ -1,0 +1,15 @@
+"""Test fixture: external runtime-env plugin loaded by daemons via
+RAY_TPU_RUNTIME_ENV_PLUGINS (see test_runtime_env.test_plugin_abc_end_to_end)."""
+
+from ray_tpu.core.runtime_env import RuntimeEnvPlugin
+
+
+class StampPlugin(RuntimeEnvPlugin):
+    name = "stamp"
+    priority = 3
+
+    def process(self, value, renv, gcs):
+        return f"processed:{value}"
+
+    def materialize(self, value, resolved, ctx, gcs, cache_dir):
+        ctx.env_vars["RTPU_STAMP"] = value
