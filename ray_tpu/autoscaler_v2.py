@@ -1,0 +1,375 @@
+"""Autoscaler v2: reconciling instance manager over async cloud providers.
+
+Re-design of the reference's autoscaler v2 (reference:
+python/ray/autoscaler/v2/instance_manager/instance_manager.py:29 — the
+instance state machine — and autoscaler/v2/autoscaler.py:42; provider ABC
+python/ray/autoscaler/node_provider.py:13, cloud impls _private/aws/,
+_private/gcp/). Where v1-style scaling (ray_tpu/autoscaler.py) assumes a
+provider that creates nodes SYNCHRONOUSLY, real clouds allocate
+asynchronously, fail, and lose machines — so v2 is a reconciler: it
+holds desired state (instance records) and drives each instance through
+
+    QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING
+                     \\-> ALLOCATION_FAILED (retry w/ backoff)
+    ... -> TERMINATING -> TERMINATED
+
+against what the cloud and the GCS actually report. A TPU slice is
+requested atomically (all hosts or none), mirroring the slice-gang
+scheduler's contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# Instance lifecycle states (reference: v2 instance_manager's
+# Instance.status values, collapsed to the load-bearing subset).
+QUEUED = "QUEUED"
+REQUESTED = "REQUESTED"
+ALLOCATED = "ALLOCATED"
+RAY_RUNNING = "RAY_RUNNING"
+ALLOCATION_FAILED = "ALLOCATION_FAILED"
+TERMINATING = "TERMINATING"
+TERMINATED = "TERMINATED"
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    shape: Dict[str, Any]  # {"cpus": .., "tpus": .., "slice_hosts": ..}
+    status: str = QUEUED
+    cloud_id: Optional[str] = None  # provider's handle once REQUESTED
+    node_id: Optional[str] = None  # ray node id once RAY_RUNNING
+    requested_at: float = 0.0
+    retries: int = 0
+    history: List[str] = field(default_factory=list)
+
+    def to(self, status: str) -> None:
+        self.history.append(self.status)
+        self.status = status
+
+
+class CloudProvider:
+    """Async provider ABC (reference: node_provider.py:13, made honest
+    about asynchrony): request() returns immediately; poll() reports the
+    cloud's view; the reconciler converges the difference."""
+
+    def request(self, instance: Instance) -> str:
+        """Begins allocation; returns the provider's cloud_id."""
+        raise NotImplementedError
+
+    def poll(self) -> Dict[str, str]:
+        """cloud_id -> "pending" | "running" | "failed" | "gone"."""
+        raise NotImplementedError
+
+    def terminate(self, cloud_id: str) -> None:
+        raise NotImplementedError
+
+    def ray_node_for(self, cloud_id: str) -> Optional[str]:
+        """The ray node id running on this instance, if the provider can
+        tell (the fake can; clouds match by node labels/IP)."""
+        return None
+
+
+class GCETPUProvider(CloudProvider):
+    """GCE TPU-VM provider shelling out to `gcloud compute tpus tpu-vm`
+    (reference: _private/gcp/node_provider.py; TPU pod slices allocate
+    atomically — one create call per slice). Requires gcloud on PATH and
+    an authenticated project; every call degrades with a clear error."""
+
+    def __init__(self, zone: str, project: str, accelerator_type: str = "v5litepod-8",
+                 version: str = "tpu-ubuntu2204-base", startup_script: str = ""):
+        import shutil
+
+        if shutil.which("gcloud") is None:
+            raise RuntimeError(
+                "GCETPUProvider needs the gcloud CLI on PATH (authenticated "
+                "for the target project); none found"
+            )
+        self.zone, self.project = zone, project
+        self.accelerator_type = accelerator_type
+        self.version = version
+        self.startup_script = startup_script
+
+    def _run(self, *args: str) -> str:
+        import subprocess
+
+        cmd = [
+            "gcloud", "compute", "tpus", "tpu-vm", *args,
+            f"--zone={self.zone}", f"--project={self.project}", "--format=json",
+        ]
+        out = subprocess.run(cmd, capture_output=True, text=True)
+        if out.returncode != 0:
+            raise RuntimeError(f"gcloud failed: {out.stderr[-500:]}")
+        return out.stdout
+
+    def request(self, instance: Instance) -> str:
+        name = f"raytpu-{instance.instance_id[:12]}"
+        self._run(
+            "create", name, f"--accelerator-type={self.accelerator_type}",
+            f"--version={self.version}", "--async",
+            *( [f"--metadata=startup-script={self.startup_script}"]
+               if self.startup_script else [] ),
+        )
+        return name
+
+    def poll(self) -> Dict[str, str]:
+        import json as _json
+
+        rows = _json.loads(self._run("list") or "[]")
+        out: Dict[str, str] = {}
+        for r in rows:
+            name = r.get("name", "").rsplit("/", 1)[-1]
+            state = r.get("state", "")
+            out[name] = {
+                "READY": "running",
+                "CREATING": "pending",
+                "FAILED": "failed",
+            }.get(state, "pending")
+        return out
+
+    def terminate(self, cloud_id: str) -> None:
+        self._run("delete", cloud_id, "--quiet", "--async")
+
+
+class FakeCloudProvider(CloudProvider):
+    """Deterministic async cloud for tests/e2e (reference:
+    _private/fake_multi_node/node_provider.py:236 FakeMultiNodeProvider):
+    allocations become "running" after `delay_s`, optionally failing the
+    first `fail_first` requests; a running instance starts a REAL local
+    node in the given Cluster so ray actually joins."""
+
+    def __init__(self, cluster, delay_s: float = 0.2, fail_first: int = 0):
+        self._cluster = cluster
+        self.delay_s = delay_s
+        self._fail_budget = fail_first
+        self._lock = threading.Lock()
+        self._instances: Dict[str, dict] = {}
+
+    def request(self, instance: Instance) -> str:
+        cloud_id = f"fake-{uuid.uuid4().hex[:8]}"
+        with self._lock:
+            fail = self._fail_budget > 0
+            if fail:
+                self._fail_budget -= 1
+            self._instances[cloud_id] = {
+                "ready_at": time.monotonic() + self.delay_s,
+                "fail": fail,
+                "node_id": None,
+                "shape": instance.shape,
+            }
+        return cloud_id
+
+    def poll(self) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        with self._lock:
+            items = list(self._instances.items())
+        for cid, rec in items:
+            if rec["fail"]:
+                out[cid] = "failed"
+            elif time.monotonic() >= rec["ready_at"]:
+                if rec["node_id"] is None:
+                    rec["node_id"] = self._cluster.add_node(
+                        num_cpus=rec["shape"].get("cpus", 2.0), num_workers=0
+                    )
+                out[cid] = "running"
+            else:
+                out[cid] = "pending"
+        return out
+
+    def ray_node_for(self, cloud_id: str) -> Optional[str]:
+        rec = self._instances.get(cloud_id)
+        return rec and rec["node_id"]
+
+    def terminate(self, cloud_id: str) -> None:
+        with self._lock:
+            rec = self._instances.pop(cloud_id, None)
+        if rec and rec["node_id"]:
+            try:
+                self._cluster.remove_node(rec["node_id"])
+            except Exception:
+                pass
+
+
+class InstanceManager:
+    """The reconciler (reference: instance_manager.py:29): converges the
+    instance table toward `target` instances RAY_RUNNING, absorbing async
+    allocation, failures, and node death."""
+
+    def __init__(
+        self,
+        provider: CloudProvider,
+        gcs=None,
+        *,
+        shape: Optional[Dict[str, Any]] = None,
+        request_timeout_s: float = 120.0,
+        max_retries: int = 3,
+        retry_backoff_s: float = 1.0,
+    ):
+        self._provider = provider
+        self._gcs = gcs
+        self.shape = shape or {"cpus": 2.0}
+        self.request_timeout_s = request_timeout_s
+        self.max_retries = max_retries
+        self.retry_backoff_s = retry_backoff_s
+        self.target = 0
+        self.instances: Dict[str, Instance] = {}
+        self._lock = threading.Lock()
+        self._retry_at: Dict[str, float] = {}
+
+    # ------------------------------------------------------------- control
+    def set_target(self, n: int) -> None:
+        with self._lock:
+            self.target = int(n)
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for inst in self.instances.values():
+                out[inst.status] = out.get(inst.status, 0) + 1
+            return out
+
+    def _live(self) -> List[Instance]:
+        """Instances counting toward the target — including failed ones
+        that will still retry (queuing a replacement for those would
+        double capacity once the retry succeeds)."""
+        return [
+            i
+            for i in self.instances.values()
+            if i.status in (QUEUED, REQUESTED, ALLOCATED, RAY_RUNNING)
+            or (i.status == ALLOCATION_FAILED and i.retries < self.max_retries)
+        ]
+
+    # ----------------------------------------------------------- reconcile
+    def reconcile(self) -> None:
+        """One reconciliation round; call from a control loop."""
+        now = time.monotonic()
+        cloud = {}
+        try:
+            cloud = self._provider.poll()
+        except Exception:
+            pass  # provider hiccup: drive off the last view next round
+
+        # None = GCS unreachable (no information; keep prior judgement);
+        # an EMPTY set is a real observation (all nodes dead).
+        alive_nodes: Optional[set] = None
+        if self._gcs is not None:
+            try:
+                alive_nodes = {
+                    n["NodeID"] for n in self._gcs.call("list_nodes") if n["Alive"]
+                }
+            except Exception:
+                alive_nodes = None
+
+        with self._lock:
+            # 1. Observe: move REQUESTED/ALLOCATED along per the cloud view.
+            for inst in list(self.instances.values()):
+                if inst.status == REQUESTED:
+                    state = cloud.get(inst.cloud_id)
+                    if state == "running":
+                        inst.to(ALLOCATED)
+                    elif state == "failed" or (
+                        now - inst.requested_at > self.request_timeout_s
+                    ):
+                        self._fail(inst, now)
+                if inst.status == ALLOCATED:
+                    state = cloud.get(inst.cloud_id)
+                    if state in ("failed", "gone", None) and cloud:
+                        # The machine vanished between cloud-READY and ray
+                        # join (preemption/manual delete): fail + replace.
+                        self._fail(inst, now)
+                        continue
+                    node = self._provider.ray_node_for(inst.cloud_id)
+                    if node and (alive_nodes is None or node in alive_nodes):
+                        inst.node_id = node
+                        inst.to(RAY_RUNNING)
+                    elif now - inst.requested_at > self.request_timeout_s * 2:
+                        # Cloud says running but ray never joined (boot
+                        # script wedged): give up on this machine.
+                        self._fail(inst, now)
+                        continue
+                if inst.status == RAY_RUNNING and alive_nodes is not None and (
+                    inst.node_id not in alive_nodes
+                ):
+                    # The machine's ray node died (crash/preemption):
+                    # terminate and let scale-up replace it.
+                    inst.to(TERMINATING)
+                if inst.status == TERMINATING:
+                    try:
+                        self._provider.terminate(inst.cloud_id)
+                        inst.to(TERMINATED)
+                    except Exception:
+                        pass  # retry next round
+
+            # 2. Retry failed allocations after backoff.
+            for inst in list(self.instances.values()):
+                if inst.status == ALLOCATION_FAILED:
+                    if inst.retries >= self.max_retries:
+                        continue
+                    if now >= self._retry_at.get(inst.instance_id, 0.0):
+                        inst.retries += 1
+                        inst.to(QUEUED)
+
+            # 3. Converge count: queue new / terminate surplus.
+            live = self._live()
+            for _ in range(self.target - len(live)):
+                iid = uuid.uuid4().hex
+                self.instances[iid] = Instance(iid, dict(self.shape))
+            surplus = len(live) - self.target
+            if surplus > 0:
+                # Prefer terminating the least-progressed instances.
+                order = {
+                    ALLOCATION_FAILED: 0,
+                    QUEUED: 1,
+                    REQUESTED: 2,
+                    ALLOCATED: 3,
+                    RAY_RUNNING: 4,
+                }
+                for inst in sorted(live, key=lambda i: order[i.status])[:surplus]:
+                    if inst.status in (QUEUED, ALLOCATION_FAILED):
+                        inst.to(TERMINATED)
+                    else:
+                        inst.to(TERMINATING)
+
+            # 4. Collect queued requests; issue them OUTSIDE the lock
+            # (a real provider's request is a seconds-long cloud call;
+            # holding the lock would block set_target/counts for the
+            # whole batch).
+            to_request = [i for i in self.instances.values() if i.status == QUEUED]
+        for inst in to_request:
+            try:
+                cloud_id = self._provider.request(inst)
+            except Exception:
+                with self._lock:
+                    self._fail(inst, now)
+                continue
+            with self._lock:
+                inst.cloud_id = cloud_id
+                inst.requested_at = now
+                inst.to(REQUESTED)
+
+    def _fail(self, inst: Instance, now: float) -> None:
+        inst.to(ALLOCATION_FAILED)
+        if inst.cloud_id:
+            try:
+                self._provider.terminate(inst.cloud_id)
+            except Exception:
+                pass
+            inst.cloud_id = None
+        self._retry_at[inst.instance_id] = now + self.retry_backoff_s * (
+            2**inst.retries
+        )
+
+    # ------------------------------------------------------------ blocking
+    def wait_running(self, n: int, timeout: float = 60.0, interval: float = 0.1) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.reconcile()
+            if self.counts().get(RAY_RUNNING, 0) >= n:
+                return True
+            time.sleep(interval)
+        return False

@@ -1497,6 +1497,7 @@ class Cluster:
             json.dumps(res),
             str(self._store_capacity),
             json.dumps(labels or {}),
+            str(num_workers if num_workers is not None else 0),
         ]
         if self._tcp_mode:
             cmd.append(f"tcp://{self._node_ip}:0")
@@ -1615,6 +1616,7 @@ def start_worker_node(
             json.dumps(res),
             str(capacity),
             json.dumps(labels or {}),
+            "0",  # prestart count (argv[7]; tcp spec follows)
             f"tcp://{node_ip}:0",
         ],
     )

@@ -429,6 +429,12 @@ class ActorChannel:
         with self._lock:
             st = self._state
             if st == "CONNECTING":
+                # Register completion interest NOW, not at send time: a
+                # get() racing the channel connect must take the ack-wakeup
+                # wait, not commit to a multi-second raylet poll that can
+                # never see an inline-only result (measured: this was a
+                # flat 2 s on every create->first-call sequence).
+                self._rt._fast_register(entry)
                 self._buffer.append(entry)
                 return
             if st == "DEAD":
@@ -515,6 +521,9 @@ class ActorChannel:
             buf, self._buffer = self._buffer, []
             self._state = "SLOW"
         for e in buf:
+            # These results will arrive via the raylet path: drop the
+            # fast-path interest or get() idles 5 s on the ack cv first.
+            self._rt._fast_sealed(e["return_ids"])
             try:
                 self._rt._submit_actor_slow(e)
             except Exception as err:
@@ -528,6 +537,7 @@ class ActorChannel:
         err = exc.ActorDiedError(self.aid, reason)
         for e in buf:
             self._rt._store_error_object(e, err)
+            self._rt._fast_sealed(e["return_ids"])
 
     def _on_conn_dead(self, entries: List[dict]) -> None:
         """Socket to the actor worker broke: fail what was in flight (the

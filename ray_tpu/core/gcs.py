@@ -64,6 +64,9 @@ class GcsService:
         self._freed: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
         self._raylet_clients: Dict[str, Any] = {}
         self._user_metrics: Dict[Tuple, dict] = {}
+        # General pubsub channels: name -> [(seq, message)] (bounded).
+        self._pubsub: Dict[str, List[Tuple[int, Any]]] = {}
+        self._pubsub_cv = threading.Condition()
         self._stop = threading.Event()
         # Write-ahead delta log between snapshots (reference: the Redis
         # store client persists control-table mutations as they happen,
@@ -942,6 +945,41 @@ class GcsService:
     def kv_keys(self, prefix: str = "") -> List[str]:
         with self._lock:
             return [k for k in self._kv if k.startswith(prefix)]
+
+    # ----------------------------------------------------------- pubsub
+    # General-purpose channels (reference: src/ray/pubsub/publisher.h
+    # long-poll publisher + subscriber.h): per-channel bounded sequence
+    # log; subscribers long-poll for entries after their cursor and get
+    # woken the moment something publishes. Lazy channel creation, no
+    # registration handshake — a subscriber is just a cursor.
+    _PUBSUB_RETAIN = 1024
+
+    def pubsub_publish(self, channel: str, message: Any) -> int:
+        with self._pubsub_cv:
+            log = self._pubsub.setdefault(channel, [])
+            seq = (log[-1][0] + 1) if log else 1
+            log.append((seq, message))
+            if len(log) > self._PUBSUB_RETAIN:
+                del log[: len(log) - self._PUBSUB_RETAIN]
+            self._pubsub_cv.notify_all()
+        return seq
+
+    def pubsub_poll(
+        self, channel: str, after_seq: int = 0, timeout: float = 10.0
+    ) -> List[Tuple[int, Any]]:
+        """Entries with seq > after_seq; blocks up to `timeout` when there
+        are none yet (the long-poll half of the reference's protocol)."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._pubsub_cv:
+            while True:
+                log = self._pubsub.get(channel, [])
+                out = [(s, m) for s, m in log if s > after_seq]
+                if out:
+                    return out
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._pubsub_cv.wait(timeout=min(remaining, 1.0))
 
     # ------------------------------------------------------ placement grp
     def _plan_bundles(

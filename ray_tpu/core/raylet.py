@@ -64,7 +64,9 @@ class RayletService:
         store_capacity: int,
         labels: Optional[Dict[str, Any]] = None,
         advertise_address: Optional[str] = None,
+        prestart_workers: int = 0,
     ):
+        self._prestart_workers = int(prestart_workers)
         self.node_id = node_id
         self.sock_path = sock_path
         # The address other NODES reach this raylet at. Defaults to the
@@ -126,6 +128,15 @@ class RayletService:
         self._remote_raylets: Dict[str, RpcClient] = {}
         self._stop = threading.Event()
 
+        # Worker zygote: a pre-warmed single-threaded forker that cuts the
+        # ~2 s interpreter+jax startup of every fresh worker to a ~10 ms
+        # fork (core/zygote.py; reference: worker_pool.h prestart). Booted
+        # lazily off-thread so raylet startup never waits on it; until
+        # ready (or if disabled/dead) spawns take the normal Popen path.
+        # The thread starts at the END of __init__ (it reads _log_dir).
+        self._zygote_proc: Optional[subprocess.Popen] = None
+        self._zygote: Optional[Any] = None
+
         # Event-driven object plane: local seals notify this condition so
         # wait_objects() long-polls wake immediately instead of the old 5 ms
         # busy-poll (reference: pubsub WAIT_FOR_OBJECT_EVICTION/locality
@@ -185,6 +196,10 @@ class RayletService:
         self._cluster_size = reg.get("nodes", 1) if isinstance(reg, dict) else 1
         for t in self._threads:
             t.start()
+        if CONFIG.worker_zygote:
+            threading.Thread(
+                target=self._boot_zygote, daemon=True, name="zygote-boot"
+            ).start()
 
     # ----------------------------------------------- control-plane batching
     def _notify_sealed(self, oid_hexes: List[str], primary: bool = True) -> None:
@@ -1562,17 +1577,28 @@ class RayletService:
             if not self._try_acquire_entry(entry):
                 self._maybe_reclaim_leases(entry["resources"])
                 return False
-            w = self._spawn_worker(
-                actor_id=entry["actor_id"],
-                env_key=self._env_key(entry),
-                runtime_env=entry.get("runtime_env"),
-            )
+            # Prefer converting an IDLE pooled worker over spawning: a
+            # fresh python process pays ~2s of interpreter+jax startup on
+            # this image, the pool already paid it (reference: the shared
+            # worker_pool serving actor creations, worker_pool.h PopWorker).
+            env_key = self._env_key(entry)
+            with self._workers_lock:
+                w = self._pop_idle_locked(env_key)
+                if w is not None:
+                    w.actor_id = entry["actor_id"]
+            if w is None:
+                w = self._spawn_worker(
+                    actor_id=entry["actor_id"],
+                    env_key=env_key,
+                    runtime_env=entry.get("runtime_env"),
+                )
             with self._actor_lock:
                 a = self._actors.get(entry["actor_id"])
                 if a is not None:
                     a["worker_id"] = w.worker_id
                     a["resources_held"] = True
             w.busy_with = entry
+            self._task_event(entry["task_id"], "RUNNING")
             w.mailbox.put({"type": "task", "entry": entry})
             return True
         if kind == "actor_task":
@@ -1621,14 +1647,23 @@ class RayletService:
             return ""
         return json.dumps(desc, sort_keys=True)
 
+    def _pop_idle_locked(self, env_key: str) -> Optional["_Worker"]:
+        """Pops a LIVE idle worker for this env (callers hold
+        _workers_lock); shared by task checkout and actor-creation
+        conversion so liveness checks stay in one place."""
+        idle = self._idle.setdefault(env_key, [])
+        while idle:
+            wid = idle.pop()
+            w = self._workers.get(wid)
+            if w is not None and w.proc.poll() is None and w.actor_id is None:
+                return w
+        return None
+
     def _checkout_worker(self, env_key: str = "") -> Optional[_Worker]:
         with self._workers_lock:
-            idle = self._idle.setdefault(env_key, [])
-            while idle:
-                wid = idle.pop()
-                w = self._workers.get(wid)
-                if w is not None and w.proc.poll() is None:
-                    return w
+            w = self._pop_idle_locked(env_key)
+            if w is not None:
+                return w
             n_task_workers = sum(1 for w in self._workers.values() if w.actor_id is None)
             if n_task_workers < self._max_task_workers:
                 return self._spawn_worker_locked(env_key=env_key)
@@ -1643,6 +1678,48 @@ class RayletService:
                         old.mailbox.put({"type": "stop"})
                     return self._spawn_worker_locked(env_key=env_key)
         return None
+
+    def _boot_zygote(self) -> None:
+        """Starts the zygote daemon, waits for its socket, then prestarts
+        the configured idle worker pool through it (background; spawns
+        fall back to Popen until — or if never — ready)."""
+        from .zygote import ZygoteClient
+
+        sock = os.path.join(
+            os.path.dirname(self.sock_path) or ".", f"zyg_{self.node_id[:8]}.sock"
+        )
+        try:
+            log = open(os.path.join(self._log_dir, "zygote.log"), "ab", buffering=0)
+            self._zygote_proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.zygote", sock],
+                stdout=log,
+                stderr=log,
+            )
+            log.close()
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and not self._stop.is_set():
+                if os.path.exists(sock):
+                    self._zygote = ZygoteClient(sock)
+                    break
+                if self._zygote_proc.poll() is not None:
+                    break  # died at boot; Popen path serves everyone
+                time.sleep(0.05)
+        except Exception as e:  # noqa: BLE001
+            print(f"raylet: zygote boot failed: {e!r}", file=sys.stderr, flush=True)
+            self._zygote = None
+        # Prestart (reference: worker_pool.h PrestartWorkers): a warm idle
+        # pool so the first task/actor burst never pays worker cold-start.
+        # Forked through the zygote these cost ~10 ms each.
+        try:
+            with self._workers_lock:
+                have = len(self._workers)
+            for _ in range(max(0, self._prestart_workers - have)):
+                if self._stop.is_set():
+                    return
+                with self._workers_lock:
+                    self._spawn_worker_locked(env_key="")
+        except Exception as e:  # noqa: BLE001
+            print(f"raylet: worker prestart failed: {e!r}", file=sys.stderr, flush=True)
 
     def _spawn_worker(
         self, actor_id: Optional[str] = None, env_key: str = "", runtime_env=None
@@ -1689,22 +1766,44 @@ class RayletService:
         # (reference: worker-<id>-out/err under the session's logs dir) —
         # a user print inside a task must be recoverable.
         log_base = os.path.join(self._log_dir, f"worker_{worker_id}")
-        out_f = open(log_base + ".out", "ab", buffering=0)
-        err_f = open(log_base + ".err", "ab", buffering=0)
-        argv = [
-            py_exe,
-            "-m",
-            "ray_tpu.core.worker_proc",
+        worker_args = [
             self.sock_path,
             self.store_path,
             self.gcs_sock,
             worker_id,
             self.node_id,
         ]
+        prefix = (renv or {}).get("_command_prefix")
+        zygote = self._zygote
+        if (
+            zygote is not None
+            and py_exe == sys.executable
+            and not prefix
+            and not (renv or {}).get("env_vars")
+        ):
+            # Fast path: fork from the pre-warmed zygote (~10 ms) — only
+            # for workers running THIS interpreter, no container wrap, and
+            # no user env_vars: the zygote pre-imported the worker stack,
+            # so import-time vars (JAX_*, RAY_TPU_* config) set after the
+            # fork would silently not take effect; those envs Popen.
+            try:
+                pid = zygote.spawn(
+                    worker_args, env, log_base + ".out", log_base + ".err"
+                )
+                from .zygote import PidHandle
+
+                w = _Worker(worker_id, PidHandle(pid), env_key=env_key)
+                w.actor_id = actor_id
+                self._workers[worker_id] = w
+                return w
+            except Exception:
+                self._zygote = None  # daemon gone: Popen from now on
+        out_f = open(log_base + ".out", "ab", buffering=0)
+        err_f = open(log_base + ".err", "ab", buffering=0)
+        argv = [py_exe, "-m", "ray_tpu.core.worker_proc", *worker_args]
         # Container plugin (image_uri): the whole worker command runs
         # inside `podman run ...` (reference: image_uri.py wrapping the
         # worker command; runtime_env.ImageUriPlugin builds the prefix).
-        prefix = (renv or {}).get("_command_prefix")
         if prefix:
             from .runtime_env import ImageUriPlugin
 
@@ -1911,13 +2010,16 @@ class RayletService:
             for w in self._workers.values():
                 if w.proc.poll() is None:
                     w.proc.terminate()
+        if self._zygote_proc is not None and self._zygote_proc.poll() is None:
+            self._zygote_proc.kill()
         return True
 
 
 def main(argv: List[str]) -> None:
     node_id, sock_path, store_path, gcs_sock, resources_json, capacity = argv[:6]
     labels = json.loads(argv[6]) if len(argv) > 6 else {}
-    tcp_spec = argv[7] if len(argv) > 7 and argv[7] else None
+    prestart = int(argv[7]) if len(argv) > 7 and argv[7] else 0
+    tcp_spec = argv[8] if len(argv) > 8 and argv[8] else None
 
     from ..utils.sampling_profiler import maybe_start_from_env
 
@@ -1935,6 +2037,7 @@ def main(argv: List[str]) -> None:
         gcs_sock,
         json.loads(resources_json),
         int(capacity),
+        prestart_workers=prestart,
         labels=labels,
         advertise_address=tcp_server.address if tcp_server else None,
     )

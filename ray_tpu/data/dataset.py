@@ -172,6 +172,53 @@ def _windowed(
         yield buf.popleft()
 
 
+class OptimizerRule:
+    """One logical-plan rewrite (reference: _internal/logical/interfaces/
+    optimizer.py Rule). apply() returns (ops, changed); the optimizer
+    iterates all registered rules to a fixpoint."""
+
+    def apply(self, ops: List[_Op]):
+        raise NotImplementedError
+
+
+class LimitPushdownRule(OptimizerRule):
+    """`map(f).limit(n)` -> `limit(n).map(f)`: row-count-preserving
+    transforms run on only the limited rows (reference:
+    rules/limit_pushdown.py)."""
+
+    def apply(self, ops: List[_Op]):
+        changed = False
+        ops = list(ops)
+        for i in _range(2, len(ops)):  # ops[0] is the source
+            if ops[i].kind == "limit" and ops[i - 1].kind == "map_rows":
+                ops[i - 1], ops[i] = ops[i], ops[i - 1]
+                changed = True
+        return ops, changed
+
+
+class LimitFusionRule(OptimizerRule):
+    """Adjacent limits collapse to the smaller one."""
+
+    def apply(self, ops: List[_Op]):
+        out: List[_Op] = []
+        changed = False
+        for op in ops:
+            if op.kind == "limit" and out and out[-1].kind == "limit":
+                out[-1] = _Op(kind="limit", n=min(out[-1].n, op.n))
+                changed = True
+            else:
+                out.append(op)
+        return out, changed
+
+
+_OPTIMIZER_RULES: List[OptimizerRule] = [LimitPushdownRule(), LimitFusionRule()]
+
+
+def register_rule(rule: OptimizerRule) -> None:
+    """Adds a custom logical-plan rule (applied on every plan build)."""
+    _OPTIMIZER_RULES.append(rule)
+
+
 class Dataset:
     """Lazy, immutable distributed dataset (reference: dataset.py:141)."""
 
@@ -244,18 +291,17 @@ class Dataset:
     # ---------------------------------------------------------- execution
     @staticmethod
     def _optimize(ops: List[_Op]) -> List[_Op]:
-        """Logical plan rules (reference: the rule-based optimizer,
-        data/_internal/logical/rules/ — operator fusion lives in
-        _plan_stages; here: LIMIT PUSHDOWN past row-count-preserving maps,
-        so `ds.map(f).limit(n)` transforms only n rows)."""
+        """Runs the registered logical-plan rules to a fixpoint
+        (reference: the rule-based optimizer, data/_internal/logical/
+        rules/ + interfaces/optimizer.py; operator FUSION lives in
+        _plan_stages). Rules are pluggable via register_rule()."""
         ops = list(ops)
         changed = True
         while changed:
             changed = False
-            for i in _range(2, len(ops)):  # ops[0] is the source
-                if ops[i].kind == "limit" and ops[i - 1].kind == "map_rows":
-                    ops[i - 1], ops[i] = ops[i], ops[i - 1]
-                    changed = True
+            for rule in _OPTIMIZER_RULES:
+                ops, rule_changed = rule.apply(ops)
+                changed = changed or rule_changed
         return ops
 
     def _plan_stages(self):

@@ -1,0 +1,79 @@
+"""General-purpose pub/sub channels over the GCS.
+
+Re-design of the reference's pubsub layer (reference:
+src/ray/pubsub/publisher.h long-poll publisher, subscriber.h; protocol
+src/ray/protobuf/pubsub.proto:232 SubscriberService). The internal
+object-seal/actor-state notifications in this framework are specialized
+event paths; THIS module is the user-facing channel surface the
+reference also exposes (logs, error, custom channels): named channels,
+at-least-once delivery from a bounded retained log, long-poll consumers.
+
+    from ray_tpu.utils import pubsub
+
+    sub = pubsub.subscribe("alerts")          # any process in the cluster
+    pubsub.publish("alerts", {"sev": "info"}) # any other process
+    msgs = sub.poll(timeout=5.0)              # [{"sev": "info"}]
+
+A subscriber is just a cursor: no registration, nothing server-side to
+leak when it goes away. Slow subscribers that fall more than the
+retention window behind miss messages (bounded memory beats unbounded
+queues; the reference's publisher buffers are bounded the same way).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+def _gcs():
+    from ..core.runtime_base import current_runtime
+
+    gcs = getattr(current_runtime(), "_gcs", None)
+    if gcs is None:
+        raise RuntimeError("pubsub needs the cluster runtime (GCS-backed)")
+    return gcs
+
+
+def publish(channel: str, message: Any) -> int:
+    """Publishes to a channel; returns the message's sequence number."""
+    return _gcs().call("pubsub_publish", channel, message)
+
+
+class Subscription:
+    """Cursor over one channel; poll() long-polls for new messages."""
+
+    def __init__(self, channel: str, from_seq: int = 0):
+        self.channel = channel
+        self._cursor = from_seq
+        self._gcs_client = _gcs()
+
+    def poll(self, timeout: float = 10.0, max_messages: Optional[int] = None) -> List[Any]:
+        entries = self._gcs_client.call(
+            "pubsub_poll",
+            self.channel,
+            self._cursor,
+            timeout,
+            timeout=timeout + 10.0,
+        )
+        if max_messages is not None:
+            entries = entries[:max_messages]
+        if entries:
+            self._cursor = entries[-1][0]
+        return [m for _, m in entries]
+
+    @property
+    def cursor(self) -> int:
+        return self._cursor
+
+
+def subscribe(channel: str, from_beginning: bool = False) -> Subscription:
+    """New subscription positioned at the channel's CURRENT tail (or its
+    retained beginning with from_beginning=True)."""
+    if from_beginning:
+        return Subscription(channel, 0)
+    sub = Subscription(channel, 0)
+    # Position at tail: read the latest seq without consuming forward.
+    entries = sub._gcs_client.call("pubsub_poll", channel, 0, 0.0)
+    if entries:
+        sub._cursor = entries[-1][0]
+    return sub

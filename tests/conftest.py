@@ -24,9 +24,17 @@ import pytest
 @pytest.fixture(scope="session", autouse=True)
 def _cpu_default_device():
     """Routes un-annotated jax computations to the CPU backend so tests never
-    touch (or wait on) the tunneled TPU chip."""
+    touch (or wait on) the tunneled TPU chip — and never INITIALIZE the
+    axon backend at all: its init does a network handshake, so a tunnel
+    outage would otherwise error every fixture (observed r5). Backends
+    initialize lazily; restricting jax_platforms before the first
+    devices() call keeps discovery CPU-only."""
     import jax
 
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # older jax: fall through, default device still pins CPU
     jax.config.update("jax_default_device", jax.devices("cpu")[0])
     yield
 

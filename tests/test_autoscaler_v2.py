@@ -1,0 +1,158 @@
+"""Autoscaler v2: reconciling instance manager over an async cloud
+(reference: autoscaler/v2/instance_manager/instance_manager.py:29 state
+machine; fake cloud mirrors _private/fake_multi_node/node_provider.py)."""
+
+import time
+
+import pytest
+
+from ray_tpu.autoscaler_v2 import (
+    ALLOCATED,
+    ALLOCATION_FAILED,
+    RAY_RUNNING,
+    REQUESTED,
+    CloudProvider,
+    FakeCloudProvider,
+    Instance,
+    InstanceManager,
+)
+
+
+class ScriptedCloud(CloudProvider):
+    """In-memory cloud with manual state control (no ray cluster)."""
+
+    def __init__(self):
+        self.state = {}
+        self.terminated = []
+        self.n = 0
+
+    def request(self, instance: Instance) -> str:
+        self.n += 1
+        cid = f"c{self.n}"
+        self.state[cid] = "pending"
+        return cid
+
+    def poll(self):
+        return dict(self.state)
+
+    def terminate(self, cloud_id):
+        self.terminated.append(cloud_id)
+        self.state.pop(cloud_id, None)
+
+    def ray_node_for(self, cloud_id):
+        return f"node-{cloud_id}" if self.state.get(cloud_id) == "running" else None
+
+
+def test_instances_progress_through_states():
+    cloud = ScriptedCloud()
+    im = InstanceManager(cloud, request_timeout_s=5.0)
+    im.set_target(3)
+    im.reconcile()
+    assert im.counts() == {REQUESTED: 3}
+    # Cloud allocates two; third still pending. A provider that reports
+    # the ray node immediately converges REQUESTED -> RAY_RUNNING in one
+    # reconcile round.
+    for cid in list(cloud.state)[:2]:
+        cloud.state[cid] = "running"
+    im.reconcile()
+    c = im.counts()
+    assert c[RAY_RUNNING] == 2 and c[REQUESTED] == 1, c
+
+
+def test_allocation_failure_retries_with_backoff():
+    cloud = ScriptedCloud()
+    im = InstanceManager(cloud, retry_backoff_s=0.05, max_retries=2)
+    im.set_target(1)
+    im.reconcile()
+    (cid,) = list(cloud.state)
+    cloud.state[cid] = "failed"
+    im.reconcile()
+    assert im.counts() == {ALLOCATION_FAILED: 1}
+    assert cloud.terminated == [cid]
+    time.sleep(0.12)
+    im.reconcile()  # back to QUEUED and re-requested in the same round
+    assert im.counts() == {REQUESTED: 1}
+    inst = next(iter(im.instances.values()))
+    assert inst.retries == 1
+
+
+def test_dead_ray_node_is_replaced():
+    cloud = ScriptedCloud()
+
+    class FakeGcs:
+        def __init__(self):
+            self.alive = []
+
+        def call(self, method, *a):
+            assert method == "list_nodes"
+            return [{"NodeID": n, "Alive": True} for n in self.alive]
+
+    gcs = FakeGcs()
+    im = InstanceManager(cloud, gcs=gcs)
+    im.set_target(1)
+    im.reconcile()
+    (cid,) = list(cloud.state)
+    cloud.state[cid] = "running"
+    gcs.alive = [f"node-{cid}"]
+    im.reconcile()
+    im.reconcile()
+    assert im.counts()[RAY_RUNNING] == 1
+    # The node dies (preemption): manager terminates + replaces.
+    gcs.alive = []
+    im.reconcile()  # observes death -> TERMINATING -> TERMINATED + queues new
+    im.reconcile()  # requests the replacement
+    c = im.counts()
+    assert c.get(REQUESTED, 0) == 1, c
+    assert cid in cloud.terminated
+
+
+def test_scale_down_prefers_least_progressed():
+    cloud = ScriptedCloud()
+    im = InstanceManager(cloud)
+    im.set_target(3)
+    im.reconcile()
+    cids = list(cloud.state)
+    cloud.state[cids[0]] = "running"
+    im.reconcile()
+    im.reconcile()  # one RAY_RUNNING, two REQUESTED
+    im.set_target(1)
+    im.reconcile()
+    c = im.counts()
+    assert c.get(RAY_RUNNING) == 1  # the running one survived
+    assert c.get("TERMINATED", 0) + c.get("TERMINATING", 0) == 2
+
+
+def test_fake_cloud_end_to_end_nodes_join():
+    """FakeCloudProvider allocations start REAL local nodes that join the
+    cluster; the manager drives them to RAY_RUNNING (the e2e analogue of
+    autoscaler/v2/tests/test_e2e.py)."""
+    import ray_tpu as rtpu
+    from ray_tpu.core import runtime_base
+    from ray_tpu.core.cluster_runtime import Cluster
+
+    rtpu.shutdown()
+    cluster = Cluster(num_cpus=1, num_workers=0)
+    rt = cluster.runtime()
+    runtime_base.set_runtime(rt)
+    try:
+        provider = FakeCloudProvider(cluster, delay_s=0.2, fail_first=1)
+        im = InstanceManager(
+            provider, gcs=rt._gcs, retry_backoff_s=0.1, request_timeout_s=10.0
+        )
+        im.set_target(2)
+        assert im.wait_running(2, timeout=60.0), im.counts()
+        nodes = [n for n in rt._gcs.call("list_nodes") if n["Alive"]]
+        assert len(nodes) == 3  # head + 2 provisioned
+        # Scale to zero: provisioned nodes terminate and leave the cluster.
+        im.set_target(0)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            im.reconcile()
+            alive = [n for n in rt._gcs.call("list_nodes") if n["Alive"]]
+            if len(alive) == 1:
+                break
+            time.sleep(0.2)
+        assert len([n for n in rt._gcs.call("list_nodes") if n["Alive"]]) == 1
+    finally:
+        rt.shutdown()
+        cluster.shutdown()

@@ -386,3 +386,41 @@ def test_streaming_executor_preserves_block_order(rt):
     ds = data.range(40, parallelism=10).sort("id", descending=True).map(jittery)
     vals = [r["id"] for r in ds.take_all()]
     assert vals == sorted(vals, reverse=True), vals
+
+
+def test_optimizer_rule_registry(rt):
+    """Rules are pluggable (reference: the rule-based optimizer interface)
+    and adjacent limits fuse."""
+    from ray_tpu.data.dataset import (
+        Dataset,
+        LimitFusionRule,
+        OptimizerRule,
+        _Op,
+        _OPTIMIZER_RULES,
+        register_rule,
+    )
+
+    ops = [
+        _Op(kind="input", blocks=[]),
+        _Op(kind="limit", n=10),
+        _Op(kind="limit", n=3),
+    ]
+    out = Dataset._optimize(ops)
+    assert [o.kind for o in out] == ["input", "limit"] and out[1].n == 3
+
+    class DropShuffleAfterSort(OptimizerRule):  # silly demo rule
+        def apply(self, ops):
+            out, changed = [], False
+            for op in ops:
+                if op.kind == "shuffle" and out and out[-1].kind == "shuffle":
+                    changed = True  # shuffle twice == shuffle once
+                    continue
+                out.append(op)
+            return out, changed
+
+    register_rule(DropShuffleAfterSort())
+    try:
+        ops2 = [_Op(kind="input", blocks=[]), _Op(kind="shuffle"), _Op(kind="shuffle")]
+        assert [o.kind for o in Dataset._optimize(ops2)] == ["input", "shuffle"]
+    finally:
+        _OPTIMIZER_RULES.pop()

@@ -1,0 +1,83 @@
+"""General pubsub channels (reference: src/ray/pubsub/ long-poll
+publisher/subscriber; the user-facing channel surface)."""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+
+
+@pytest.fixture
+def rt_cluster():
+    rt.shutdown()
+    rt.init(num_cpus=2, num_workers=1)
+    yield rt
+    rt.shutdown()
+
+
+def test_publish_poll_ordering_and_cursor(rt_cluster):
+    from ray_tpu.utils import pubsub
+
+    sub = pubsub.subscribe("chan1")
+    assert sub.poll(timeout=0.05) == []
+    pubsub.publish("chan1", "a")
+    pubsub.publish("chan1", {"b": 2})
+    msgs = sub.poll(timeout=5.0)
+    assert msgs == ["a", {"b": 2}]
+    assert sub.poll(timeout=0.05) == []  # cursor advanced
+    pubsub.publish("chan1", "c")
+    assert sub.poll(timeout=5.0) == ["c"]
+
+
+def test_subscribe_at_tail_skips_history(rt_cluster):
+    from ray_tpu.utils import pubsub
+
+    pubsub.publish("chan2", "old")
+    sub = pubsub.subscribe("chan2")
+    pubsub.publish("chan2", "new")
+    assert sub.poll(timeout=5.0) == ["new"]
+    replay = pubsub.subscribe("chan2", from_beginning=True)
+    assert replay.poll(timeout=5.0) == ["old", "new"]
+
+
+def test_cross_process_pubsub(rt_cluster):
+    """A worker-task publisher wakes a driver-side long-poll (the
+    cross-process contract the reference's log/error channels rely on)."""
+    from ray_tpu.utils import pubsub
+
+    sub = pubsub.subscribe("events")
+
+    @rt.remote
+    def announce(n):
+        from ray_tpu.utils import pubsub as ps
+
+        for i in range(n):
+            ps.publish("events", f"msg{i}")
+        return n
+
+    ref = announce.remote(3)
+    got = []
+    deadline = time.time() + 30
+    while len(got) < 3 and time.time() < deadline:
+        got += sub.poll(timeout=2.0)
+    assert got == ["msg0", "msg1", "msg2"]
+    assert rt.get(ref, timeout=30) == 3
+
+
+def test_retention_bound(rt_cluster):
+    from ray_tpu.core.gcs import GcsService
+    from ray_tpu.utils import pubsub
+
+    sub = pubsub.subscribe("flood", from_beginning=True)
+    n = GcsService._PUBSUB_RETAIN + 50
+    for i in range(n):
+        pubsub.publish("flood", i)
+    msgs = []
+    while True:
+        batch = sub.poll(timeout=0.05)
+        if not batch:
+            break
+        msgs += batch
+    assert len(msgs) == GcsService._PUBSUB_RETAIN  # oldest 50 evicted
+    assert msgs[-1] == n - 1
