@@ -153,7 +153,31 @@ def main() -> None:
     ap.add_argument("--ablate", default=None, choices=[None, "attn", "head"])
     args = ap.parse_args()
 
-    dev = jax.devices()[0]
+    # TPU tunnel outages can make backend init HANG (not raise). Probe in
+    # a SUBPROCESS (an in-process watchdog thread would wedge jax's
+    # backend-init lock for the fallback too) and degrade to the CPU
+    # smoke metric rather than wedging the round's bench capture — the
+    # metric name makes the degradation explicit.
+    import subprocess as _sp
+
+    try:
+        probe = _sp.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            capture_output=True,
+            timeout=180,
+            text=True,
+        )
+        tpu_ok = "ok" in (probe.stdout or "")
+    except _sp.TimeoutExpired:
+        tpu_ok = False
+    if not tpu_ok:
+        print("warning: TPU backend unavailable; CPU fallback", file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        dev = jax.devices()[0]
+    except RuntimeError:
+        jax.config.update("jax_platforms", "cpu")
+        dev = jax.devices("cpu")[0]
     on_tpu = dev.platform == "tpu"
 
     if args.model == "7b" and on_tpu and len(jax.devices()) < 8:

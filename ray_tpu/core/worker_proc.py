@@ -130,6 +130,18 @@ def main(argv: List[str]) -> None:
     from .rpc import RpcClient, _recv_msg, _send_msg
     from .shm_store import SharedMemoryStore
 
+    # Pin jax's platform set when the launcher asks (tests export
+    # RAY_TPU_JAX_PLATFORMS=cpu so workers never INITIALIZE the tunneled
+    # axon/TPU backend — its init does a network handshake and a tunnel
+    # outage would otherwise fail every jax-using task).
+    jp = os.environ.get("RAY_TPU_JAX_PLATFORMS")
+    if jp:
+        try:
+            import jax as _jax
+
+            _jax.config.update("jax_platforms", jp)
+        except Exception:
+            pass
     runtime_env = json.loads(os.environ.get("RAY_TPU_RUNTIME_ENV", "{}") or "{}")
     _apply_working_dir(runtime_env)
 

@@ -182,8 +182,14 @@ class _TuneController:
         self._searcher = searcher
 
         self._trials: Dict[str, Trial] = {}
-        self._actors: Dict[str, Any] = {}
-        self._pending_result: Dict[str, Any] = {}  # trial_id -> outstanding ref
+        # Shared event-driven execution layer (reference:
+        # air/execution/_internal/actor_manager.py:22 RayActorManager —
+        # the controller declares actors + callbacks; the manager owns
+        # the wait loop and in-flight bookkeeping).
+        from ..air import ActorManager
+
+        self._mgr = ActorManager()
+        self._trial_actor: Dict[str, Any] = {}  # trial_id -> TrackedActor
 
     @staticmethod
     def _resolve_trainable(trainable):
@@ -217,7 +223,8 @@ class _TuneController:
         import cloudpickle
 
         worker_cls = api.remote(max_concurrency=4)(_TrainWorker)
-        actor = worker_cls.remote(0, 1)
+        tracked = self._mgr.add_actor(worker_cls, 0, 1)
+        actor = tracked.handle
         blob = cloudpickle.dumps(self._fn)
         # Fire-and-forget launch: blocking on a setup ack here deadlocks a
         # full cluster — this actor may be QUEUED behind running trials
@@ -231,22 +238,30 @@ class _TuneController:
             setup_mesh_axes=None,
         )
         trial.status = "RUNNING"
-        self._actors[trial.trial_id] = actor
-        self._pending_result[trial.trial_id] = actor.next_result.remote()
+        self._trial_actor[trial.trial_id] = tracked
+        self._schedule_next_result(trial)
+
+    def _schedule_next_result(self, trial: Trial) -> None:
+        tracked = self._trial_actor[trial.trial_id]
+        self._mgr.schedule_task(
+            tracked,
+            "next_result",
+            on_result=lambda payload, t=trial: self._handle_result(t, payload),
+            on_error=lambda e, t=trial: self._stop_trial(t, "ERROR", error=repr(e)),
+        )
 
     def _stop_trial(
         self, trial: Trial, status: str, error: Optional[str] = None, *, notify: bool = True
     ) -> None:
-        actor = self._actors.pop(trial.trial_id, None)
-        self._pending_result.pop(trial.trial_id, None)
-        if actor is not None:
+        tracked = self._trial_actor.pop(trial.trial_id, None)
+        if tracked is not None:
             try:
                 # Unblock the training thread (it unwinds with TrialAborted
                 # at its next report) before tearing the actor down.
-                api.get(actor.stop_training.remote())
-                api.kill(actor)
+                api.get(tracked.handle.stop_training.remote())
             except Exception:
                 pass
+            self._mgr.remove_actor(tracked, kill=True)
         trial.status = status
         trial.error = error
         # PBT exploit restarts the same trial; completion callbacks would
@@ -261,15 +276,21 @@ class _TuneController:
 
     # -------------------------------------------------------------- events
     def _handle_result(self, trial: Trial, payload: Optional[Dict[str, Any]]) -> None:
-        actor = self._actors.get(trial.trial_id)
+        tracked = self._trial_actor.get(trial.trial_id)
+        actor = tracked.handle if tracked is not None else None
         if payload is None:
-            # Training function returned: drain/join and terminate.
+            # Training function returned: drain/join and terminate. The
+            # terminal _stop_trial sits OUTSIDE the try: if it partially
+            # ran (notified the searcher) and then raised, the except would
+            # re-notify the same trial as ERROR and corrupt stateful
+            # searchers.
             try:
                 api.get(actor.join.remote())
-                self._stop_trial(trial, "TERMINATED")
             except Exception as e:  # noqa: BLE001
                 trial.last_result.setdefault("error", str(e))
                 self._stop_trial(trial, "ERROR", error=repr(e))
+                return
+            self._stop_trial(trial, "TERMINATED")
             return
 
         metrics = dict(payload["metrics"])
@@ -298,9 +319,7 @@ class _TuneController:
         elif decision == STOP:
             self._stop_trial(trial, "TERMINATED")
         else:
-            self._pending_result[trial.trial_id] = self._actors[
-                trial.trial_id
-            ].next_result.remote()
+            self._schedule_next_result(trial)
         self._save_state()
 
     # ----------------------------------------------------------------- run
@@ -333,7 +352,7 @@ class _TuneController:
 
         while True:
             # Launch while there is capacity.
-            while len(self._actors) < max_conc:
+            while self._mgr.num_live_actors < max_conc:
                 cfg = self._searcher.suggest(f"trial_{next_index:05d}")
                 if cfg is None:
                     break
@@ -344,28 +363,12 @@ class _TuneController:
                     self._scheduler.register_config(trial.trial_id, cfg)
                 self._launch_trial(trial)
 
-            if not self._pending_result:
+            if not self._mgr.num_pending_tasks:
                 break
 
-            # Wait for any trial to produce a result. Randomize polling order
-            # so no trial is systematically processed first (fair rung
-            # arrival order for ASHA-style schedulers).
-            import random as _random
-
-            id_by_ref = {ref.id(): tid for tid, ref in self._pending_result.items()}
-            refs = list(self._pending_result.values())
-            _random.shuffle(refs)
-            ready, _ = api.wait(refs, num_returns=1, timeout=None)
-            ready_ref = ready[0]
-            trial_id = id_by_ref[ready_ref.id()]
-            trial = self._trials[trial_id]
-            self._pending_result.pop(trial_id, None)
-            try:
-                payload = api.get(ready_ref)
-            except Exception as e:  # noqa: BLE001
-                self._stop_trial(trial, "ERROR", error=repr(e))
-                continue
-            self._handle_result(trial, payload)
+            # One event: the manager waits fairly (random polling order)
+            # and dispatches the trial's on_result/on_error callback.
+            self._mgr.next()
 
         self._save_state(force=True)
         results = []

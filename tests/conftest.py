@@ -17,6 +17,9 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["RAY_TPU_PLATFORM"] = "cpu"
+# Worker processes pin jax to CPU too (worker_proc.main reads this): the
+# suite must be hermetic against TPU-tunnel outages.
+os.environ["RAY_TPU_JAX_PLATFORMS"] = "cpu"
 
 import pytest
 

@@ -1,0 +1,64 @@
+"""AIR shared execution layer (reference:
+air/execution/_internal/actor_manager.py:22 RayActorManager — the
+event-driven actor pool shared by library controllers; Tune's controller
+now drives it, tune/tuner.py)."""
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.air import ActorManager
+
+
+@pytest.fixture
+def rt_cluster():
+    rt.shutdown()
+    rt.init(num_cpus=4, num_workers=2)
+    yield rt
+    rt.shutdown()
+
+
+@rt.remote
+class Counter:
+    def __init__(self, base):
+        self.base = base
+
+    def add(self, x):
+        return self.base + x
+
+    def boom(self):
+        raise ValueError("kaboom")
+
+
+def test_schedule_and_event_callbacks(rt_cluster):
+    mgr = ActorManager()
+    a = mgr.add_actor(Counter, 100)
+    b = mgr.add_actor(Counter, 200)
+    assert mgr.num_live_actors == 2
+    got = []
+    for tracked, x in ((a, 1), (b, 2), (a, 3)):
+        mgr.schedule_task(tracked, "add", x, on_result=got.append)
+    while mgr.num_pending_tasks:
+        assert mgr.next(timeout=60)
+    assert sorted(got) == [101, 103, 202]
+
+
+def test_error_routes_to_on_error(rt_cluster):
+    mgr = ActorManager()
+    a = mgr.add_actor(Counter, 0)
+    errs, oks = [], []
+    mgr.schedule_task(a, "boom", on_result=oks.append, on_error=errs.append)
+    assert mgr.next(timeout=60)
+    # Actor-call failures surface as TaskError wrapping the user raise
+    # (matching rt.get semantics for actor tasks).
+    assert not oks and len(errs) == 1 and "kaboom" in str(errs[0])
+
+
+def test_remove_actor_drops_queued_events(rt_cluster):
+    mgr = ActorManager()
+    a = mgr.add_actor(Counter, 0)
+    fired = []
+    mgr.schedule_task(a, "add", 1, on_result=fired.append)
+    mgr.remove_actor(a)  # callbacks must not fire after removal
+    assert mgr.num_pending_tasks == 0
+    assert mgr.next(timeout=1) is False
+    assert fired == []
