@@ -153,6 +153,62 @@ def timeit(name: str, fn, multiplier: int = 1, min_time: float = 2.0):
     return name, rate
 
 
+def _sync_dispatch_rate(min_time: float) -> float:
+    """Best-of-3 synchronous no-op dispatch rate on a fresh cluster."""
+    @rt.remote
+    def noop():
+        return None
+
+    rt.get([noop.remote() for _ in range(64)])  # warm pool + lease
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < min_time:
+            rt.get(noop.remote())
+            n += 1
+        best = max(best, n / (time.perf_counter() - t0))
+    return best
+
+
+def bench_overhead_guard(min_time: float) -> None:
+    """Micro-overhead guard: an instrumented no-op task dispatch must stay
+    within 10% of uninstrumented. Boots the cluster twice — daemons read
+    RAY_TPU_INTERNAL_METRICS at import, so the toggle must be in their
+    spawn environment — and compares best-of-3 sync dispatch rates."""
+    import os
+
+    from ray_tpu.utils import internal_metrics as im
+
+    rates = {}
+    for label, flag in (("off", "0"), ("on", "1")):
+        os.environ["RAY_TPU_INTERNAL_METRICS"] = flag
+        im.set_enabled(flag == "1")  # driver-side instruments follow too
+        rt.init(num_cpus=8, num_workers=2, object_store_memory=256 << 20)
+        rates[label] = _sync_dispatch_rate(min_time)
+        rt.shutdown()
+    os.environ.pop("RAY_TPU_INTERNAL_METRICS", None)
+    im.set_enabled(True)
+    ratio = rates["on"] / rates["off"] if rates["off"] else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "internal_metrics_overhead",
+                "value": round(ratio, 3),
+                "unit": "x (instrumented/uninstrumented sync dispatch)",
+                "vs_baseline": None,
+                "on_ops_s": round(rates["on"], 1),
+                "off_ops_s": round(rates["off"], 1),
+            }
+        ),
+        flush=True,
+    )
+    assert ratio >= 0.90, (
+        f"internal metrics cost {100 * (1 - ratio):.1f}% of no-op dispatch "
+        f"(budget: 10%) — {rates}"
+    )
+
+
 def main():
     quick = "--quick" in sys.argv
     min_time = 0.5 if quick else 2.0
@@ -342,6 +398,8 @@ def main():
         "vs_baseline": None,
     }
     print(json.dumps(summary), flush=True)
+    # Last: a guard failure must not discard the completed run's results.
+    bench_overhead_guard(min_time)
 
 
 if __name__ == "__main__":

@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import cloudpickle
 
 from .. import exceptions as exc
+from .. import tracing as _tracing
 from ..utils.config import CONFIG
 from .ids import ActorID, ObjectID, TaskID
 from .object_transport import StoredError
@@ -49,8 +50,6 @@ def _entry_from_spec(spec: TaskSpec) -> dict:
     if spec.task_type == TaskType.NORMAL_TASK and not resources:
         resources = {"CPU": 1.0}
     streaming = spec.num_returns == "streaming"
-    from .. import tracing as _tracing
-
     return {
         "task_id": spec.task_id.hex(),
         # Span context propagation (reference: tracing_helper.py:165 —
@@ -129,6 +128,11 @@ class ClusterRuntime(Runtime):
         # _worker_id with their raylet-assigned id after attach.
         self._worker_id = f"driver-{os.getpid()}" if driver else f"worker-{os.getpid()}"
         self._namespace = "default"
+        # Stamp this process's node onto its internal-metrics records
+        # (workers re-configure with their raylet-assigned id after attach).
+        from ..utils import internal_metrics as _imet
+
+        _imet.configure(node_id=node_id, reporter=self._worker_id)
         self._actor_location: Dict[str, str] = {}  # actor_id -> raylet sock
         self._raylet_clients: Dict[str, RpcClient] = {}
         self._shutdown_done = False
@@ -1039,36 +1043,48 @@ class ClusterRuntime(Runtime):
         self._process_renv(spec)
         actor_id = spec.actor_id or ActorID.from_random()
         spec.actor_id = actor_id
-        entry = _entry_from_spec(spec)
-        # Pin constructor args for the actor's lifetime: restarts re-run the
-        # constructor from the registered spec, which must resolve them.
-        with self._ref_lock:
-            for dep in entry.get("deps", []):
-                self._local_refs[dep] = self._local_refs.get(dep, 0) + 1
-        entry["actor_id"] = actor_id.hex()
-        blob = pickle.dumps(entry)
-        node = self._gcs.call(
-            "register_actor",
-            actor_id.hex(),
-            blob,
-            # Placement bias (reference: actors use 1 CPU for SCHEDULING,
-            # 0 while alive): a DEFAULT actor holds nothing at runtime
-            # (entry["resources"] is empty) but is PLACED as if it cost a
-            # CPU, so utility-actor swarms spread instead of piling onto
-            # the most-utilized node. An EXPLICIT num_cpus=0 actor skips
-            # the bias — it must place on CPU-less custom-resource hosts.
-            entry["resources"]
-            or ({"CPU": 1.0} if spec.options.actor_placement_bias else {}),
-            spec.options.max_restarts,
-            spec.options.name,
-            spec.options.namespace,
-            spec.options.placement_group_id,
-            spec.options.bundle_index,
-            spec.options.scheduling_strategy,
-        )
-        self._raylet_for(node["sock"]).call(
-            "create_actor", blob, True, node.get("bundle_index")
-        )
+        # The actor-launch trace (VERDICT: "actor launch is 48 ms with a
+        # 10 ms fork — where are the other 38 ms?"): one parent span whose
+        # context rides the creation entry, so the raylet's dispatch/spawn
+        # and the worker's constructor phases parent under it and
+        # `ray-tpu timeline` shows the per-phase breakdown.
+        with _tracing.span("actor_launch", {"actor_id": actor_id.hex()}):
+            entry = _entry_from_spec(spec)
+            # Pin constructor args for the actor's lifetime: restarts re-run
+            # the constructor from the registered spec, which must resolve
+            # them.
+            with self._ref_lock:
+                for dep in entry.get("deps", []):
+                    self._local_refs[dep] = self._local_refs.get(dep, 0) + 1
+            entry["actor_id"] = actor_id.hex()
+            blob = pickle.dumps(entry)
+            with _tracing.span("actor_launch.gcs_register"):
+                node = self._gcs.call(
+                    "register_actor",
+                    actor_id.hex(),
+                    blob,
+                    # Placement bias (reference: actors use 1 CPU for
+                    # SCHEDULING, 0 while alive): a DEFAULT actor holds
+                    # nothing at runtime (entry["resources"] is empty) but
+                    # is PLACED as if it cost a CPU, so utility-actor swarms
+                    # spread instead of piling onto the most-utilized node.
+                    # An EXPLICIT num_cpus=0 actor skips the bias — it must
+                    # place on CPU-less custom-resource hosts.
+                    entry["resources"]
+                    or ({"CPU": 1.0} if spec.options.actor_placement_bias else {}),
+                    spec.options.max_restarts,
+                    spec.options.name,
+                    spec.options.namespace,
+                    spec.options.placement_group_id,
+                    spec.options.bundle_index,
+                    spec.options.scheduling_strategy,
+                )
+            with _tracing.span(
+                "actor_launch.submit", {"node_id": node.get("node_id", "")}
+            ):
+                self._raylet_for(node["sock"]).call(
+                    "create_actor", blob, True, node.get("bundle_index")
+                )
         self._actor_location[actor_id.hex()] = node["sock"]
         return actor_id
 

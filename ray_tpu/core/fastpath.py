@@ -32,6 +32,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from .. import exceptions as exc
+from ..utils import internal_metrics as imet
 from .rpc import _recv_msg, _send_msg, parse_address
 
 # Tunables (modest defaults; the fast path must not starve the node).
@@ -93,6 +94,7 @@ class DirectConn:
         blob = pickle.dumps(frame)
         tid = entry["task_id"]
         self.last_used = time.monotonic()
+        entry["_send_ts"] = self.last_used  # inline-result RTT measurement
         with self._iflock:
             self.inflight[tid] = entry
         try:
@@ -130,8 +132,12 @@ class DirectConn:
                 self.last_used = time.monotonic()
                 self.acked += 1
                 with self._iflock:
-                    self.inflight.pop(msg[1], None)
+                    done_entry = self.inflight.pop(msg[1], None)
                     drained = self.draining and not self.inflight
+                if done_entry is not None:
+                    ts = done_entry.get("_send_ts")
+                    if ts is not None:
+                        imet.FASTPATH_RTT.observe((self.last_used - ts) * 1e3)
                 if self._on_sealed is not None:
                     # Wake the owner's get() directly — the in-band ack
                     # beats the raylet's batched seal notification by ~ms.

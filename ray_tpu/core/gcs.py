@@ -22,6 +22,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ..utils import internal_metrics as imet
 from ..utils.config import CONFIG
 
 HEARTBEAT_TIMEOUT_S = CONFIG.heartbeat_timeout_s
@@ -64,8 +65,13 @@ class GcsService:
         self._freed: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
         self._raylet_clients: Dict[str, Any] = {}
         self._user_metrics: Dict[Tuple, dict] = {}
+        # Runtime-internal metrics table (reference: metric_defs.cc
+        # runtime metrics aggregated by the head's metrics agent) — same
+        # merge semantics as the user table, separate namespace.
+        self._internal_metrics: Dict[Tuple, dict] = {}
         # General pubsub channels: name -> [(seq, message)] (bounded).
         self._pubsub: Dict[str, List[Tuple[int, Any]]] = {}
+        self._pubsub_total = 0  # running entry count across channels
         self._pubsub_cv = threading.Condition()
         self._stop = threading.Event()
         # Write-ahead delta log between snapshots (reference: the Redis
@@ -330,15 +336,17 @@ class GcsService:
                 )
         return out
 
-    def report_metrics(self, worker_id: str, records: List[dict]) -> bool:
-        """Aggregates user-defined application metrics (reference:
-        src/ray/stats/metric.h registry + exporter; python surface
-        ray.util.metrics). Counters accumulate deltas; gauges keep the
-        last value per (worker, tags); histograms merge bucket counts."""
+    def _merge_metric_records(
+        self, table: Dict[Tuple, dict], worker_id: str, records: List[dict]
+    ) -> bool:
+        """Shared aggregation for the user and internal metrics tables
+        (reference: src/ray/stats/metric.h registry + exporter). Counters
+        accumulate deltas; gauges keep the last value per (worker, tags);
+        histograms merge bucket counts."""
         with self._lock:
             for rec in records:
                 key = (rec["name"], tuple(sorted(rec.get("tags", {}).items())))
-                entry = self._user_metrics.setdefault(
+                entry = table.setdefault(
                     key,
                     {
                         "name": rec["name"],
@@ -361,11 +369,11 @@ class GcsService:
                     entry.setdefault("boundaries", rec.get("boundaries"))
         return True
 
-    def user_metrics(self) -> List[dict]:
+    def _metrics_view(self, table: Dict[Tuple, dict]) -> List[dict]:
         now = time.monotonic()
         out: List[dict] = []
         with self._lock:
-            for v in self._user_metrics.values():
+            for v in table.values():
                 if v["kind"] == "gauge":
                     # A dead worker's last gauge value must not inflate the
                     # cluster sum forever: reporters stale for 30 s are
@@ -383,6 +391,31 @@ class GcsService:
                     entry["gauges"] = {w: val for w, (val, _) in v["gauges"].items()}
                 out.append(entry)
         return out
+
+    def report_metrics(self, worker_id: str, records: List[dict]) -> bool:
+        """User-defined application metrics (ray_tpu.utils.metrics)."""
+        return self._merge_metric_records(self._user_metrics, worker_id, records)
+
+    def user_metrics(self) -> List[dict]:
+        return self._metrics_view(self._user_metrics)
+
+    def report_internal_metrics(self, worker_id: str, records: List[dict]) -> bool:
+        """Runtime-internal metrics (ray_tpu.utils.internal_metrics) —
+        flushed by raylets, the GCS itself, workers, and drivers."""
+        return self._merge_metric_records(self._internal_metrics, worker_id, records)
+
+    def internal_metrics(self) -> List[dict]:
+        return self._metrics_view(self._internal_metrics)
+
+    def _observe_rpc(self, method: str, latency_ms: float) -> None:
+        """Per-method RPC accounting hook invoked by RpcServer (only the
+        GCS opts in — the raylet's task fast path stays uninstrumented at
+        the RPC layer)."""
+        imet.GCS_RPC_TOTAL.inc(method=method)
+        if method != "pubsub_poll":
+            # Long-poll duration is the subscriber's wait, not GCS work —
+            # it would drown the latency histogram.
+            imet.GCS_RPC_LATENCY.observe(latency_ms, method=method)
 
     def stats(self) -> dict:
         """Cluster-wide counters (reference: src/ray/stats/metric.h — the
@@ -959,9 +992,14 @@ class GcsService:
             log = self._pubsub.setdefault(channel, [])
             seq = (log[-1][0] + 1) if log else 1
             log.append((seq, message))
+            self._pubsub_total += 1
             if len(log) > self._PUBSUB_RETAIN:
-                del log[: len(log) - self._PUBSUB_RETAIN]
+                trimmed = len(log) - self._PUBSUB_RETAIN
+                del log[:trimmed]
+                self._pubsub_total -= trimmed
+            backlog = self._pubsub_total  # O(1): gauge off the lock's path
             self._pubsub_cv.notify_all()
+        imet.GCS_PUBSUB_BACKLOG.set(backlog)
         return seq
 
     def pubsub_poll(
@@ -1342,6 +1380,13 @@ def main(
     from .rpc import RpcServer
 
     service = GcsService(snapshot_path=snapshot_path or sock_path + ".snapshot")
+    # The GCS's own internal metrics merge straight into its table — no
+    # self-RPC loop (reference: the head metrics agent scraping itself).
+    imet.configure(
+        node_id="gcs",
+        reporter="gcs",
+        sink=lambda recs: service.report_internal_metrics("gcs", recs),
+    )
     server = RpcServer(sock_path, service)
     tcp_server = RpcServer(tcp_address, service) if tcp_address else None
     if tcp_server is not None:

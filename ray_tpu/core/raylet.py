@@ -30,6 +30,8 @@ import uuid
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .. import exceptions as exc
+from .. import tracing as _tracing
+from ..utils import internal_metrics as imet
 from ..utils.config import CONFIG
 from .ids import ObjectID
 from .object_transport import StoredError
@@ -194,6 +196,19 @@ class RayletService:
             "register_node", node_id, self.advertised, store_path, resources, self.labels
         )
         self._cluster_size = reg.get("nodes", 1) if isinstance(reg, dict) else 1
+        # Internal metrics: this raylet's hot-path instruments flush
+        # through its existing GCS client (batched, off the fast path),
+        # and the per-node ReporterAgent collects cpu/mem/fd/device
+        # gauges (reference: reporter_agent.py:336).
+        imet.configure(
+            node_id=node_id,
+            reporter=f"raylet_{node_id}",
+            sink=lambda recs: self.gcs.call(
+                "report_internal_metrics", f"raylet_{node_id}", recs
+            ),
+        )
+        self._reporter = imet.ReporterAgent()
+        self._reporter.start()
         for t in self._threads:
             t.start()
         if CONFIG.worker_zygote:
@@ -223,6 +238,13 @@ class RayletService:
             self._evt_buf.append(evt)
         self._buf_wake.set()
 
+    def _enqueue(self, entry: dict) -> None:
+        """Queues one entry for the local scheduler; stamps queue-entry
+        time so dispatch can report queue-to-dispatch latency."""
+        entry["_q_ts"] = time.monotonic()
+        self._pending.put(entry)
+        self._sched_wake.set()
+
     def _flush_loop(self) -> None:
         """Drains location + task-event buffers to the GCS (batched; the
         object fast path never blocks on a GCS round trip)."""
@@ -236,6 +258,8 @@ class RayletService:
                 continue
             try:
                 self.gcs.call("node_sync", self.node_id, locs, evts)
+                imet.GCS_SYNC_TOTAL.inc()
+                imet.GCS_SYNC_BATCH.observe(len(locs) + len(evts))
             except Exception:
                 with self._buf_lock:  # GCS briefly unreachable: retry later
                     self._loc_buf = locs + self._loc_buf
@@ -452,8 +476,7 @@ class RayletService:
             # Bundle-pinned: the driver routed it to this node; never spill.
             entry["type"] = "task"
             self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
-            self._pending.put(entry)
-            self._sched_wake.set()
+            self._enqueue(entry)
             return entry["return_ids"]
         if not forwarded:
             strategy = entry.get("strategy") or "DEFAULT"
@@ -491,8 +514,7 @@ class RayletService:
                     # Affinity to this node: queue here, skip spillback.
                     entry["type"] = "task"
                     self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
-                    self._pending.put(entry)
-                    self._sched_wake.set()
+                    self._enqueue(entry)
                     return entry["return_ids"]
             elif strategy == "SPREAD":
                 # Round-robin over feasible nodes (reference: spread policy,
@@ -531,8 +553,7 @@ class RayletService:
                     pass
         entry["type"] = "task"
         self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
-        self._pending.put(entry)
-        self._sched_wake.set()
+        self._enqueue(entry)
         return entry["return_ids"]
 
     def _place_affinity(
@@ -597,8 +618,7 @@ class RayletService:
             pass
         entry["type"] = "task"
         self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
-        self._pending.put(entry)
-        self._sched_wake.set()
+        self._enqueue(entry)
 
     def _place_elsewhere(self, entry: dict, spec_blob: bytes) -> None:
         """Finds a node for a task this node can never run; retries while
@@ -690,8 +710,7 @@ class RayletService:
                 "resources_held": False,
             }
         self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
-        self._pending.put(entry)
-        self._sched_wake.set()
+        self._enqueue(entry)
         return True
 
     def submit_actor_task(self, spec_blob: bytes) -> List[bytes]:
@@ -709,8 +728,7 @@ class RayletService:
                 )
                 return entry["return_ids"]
         self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
-        self._pending.put(entry)
-        self._sched_wake.set()
+        self._enqueue(entry)
         return entry["return_ids"]
 
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> bool:
@@ -895,6 +913,7 @@ class RayletService:
             except exc.ObjectStoreFullError:
                 self.ensure_space(len(raw))
                 self.store.put_raw(oid, raw)
+            imet.OBJECT_BYTES_IN.inc(len(raw))
             return True
         try:
             pool_off = self.store.begin_put_raw(oid, size)
@@ -914,6 +933,7 @@ class RayletService:
                 pos += len(piece)
             self.store.finish_put_raw(oid)
             sealed = True
+            imet.OBJECT_BYTES_IN.inc(size)
             return True
         finally:
             if not sealed:
@@ -1007,6 +1027,7 @@ class RayletService:
         with self._serve_sem:
             piece = self.store.read_raw_chunk(oid, offset, length)
         if piece is not None:
+            imet.OBJECT_BYTES_OUT.inc(len(piece))
             return piece
         with self._spill_lock:
             path = self._spilled.get(oid_hex)
@@ -1014,7 +1035,9 @@ class RayletService:
             try:
                 with open(path, "rb") as f:
                     f.seek(offset)
-                    return f.read(length)
+                    piece = f.read(length)
+                imet.OBJECT_BYTES_OUT.inc(len(piece))
+                return piece
             except OSError:
                 return None
         return None
@@ -1025,13 +1048,16 @@ class RayletService:
         primaries are served straight from disk."""
         raw = self.store.get_raw(ObjectID.from_hex(oid_hex))
         if raw is not None:
+            imet.OBJECT_BYTES_OUT.inc(len(raw))
             return raw
         with self._spill_lock:
             path = self._spilled.get(oid_hex)
         if path is not None:
             try:
                 with open(path, "rb") as f:
-                    return f.read()
+                    raw = f.read()
+                imet.OBJECT_BYTES_OUT.inc(len(raw))
+                return raw
             except OSError:
                 return None
         return None
@@ -1092,6 +1118,8 @@ class RayletService:
             with self._spill_lock:
                 self._spilled[h] = path
                 self._local_objects.pop(h, None)
+            imet.OBJECT_SPILL_TOTAL.inc()
+            imet.OBJECT_SPILL_BYTES.inc(len(raw))
             return True
         try:
             os.unlink(path)  # pinned after all; keep the pool copy
@@ -1142,6 +1170,7 @@ class RayletService:
                 os.unlink(path)
             except OSError:
                 pass
+        imet.OBJECT_RESTORE_TOTAL.inc()
         self._notify_sealed([oid_hex])
         return True
 
@@ -1527,6 +1556,7 @@ class RayletService:
                     except Exception:
                         pass
             self._waiting = still
+            imet.SCHED_QUEUE_DEPTH.set(len(still) + self._pending.qsize())
 
     def _deps_ready(self, entry: dict) -> bool:
         for dep_hex in entry.get("deps", []):
@@ -1538,6 +1568,11 @@ class RayletService:
                 self._pull_async(dep_hex)
                 return False
         return True
+
+    def _obs_dispatch(self, entry: dict) -> None:
+        ts = entry.pop("_q_ts", None)
+        if ts is not None:
+            imet.SCHED_DISPATCH_LATENCY.observe((time.monotonic() - ts) * 1e3)
 
     def _dispatch(self, entry: dict) -> bool:
         kind = entry["type"]
@@ -1560,6 +1595,7 @@ class RayletService:
             if w is None:
                 self._release_entry(entry)
                 return False
+            self._obs_dispatch(entry)
             w.busy_with = entry
             self._task_event(entry["task_id"], "RUNNING")
             w.mailbox.put({"type": "task", "entry": entry})
@@ -1581,17 +1617,30 @@ class RayletService:
             # fresh python process pays ~2s of interpreter+jax startup on
             # this image, the pool already paid it (reference: the shared
             # worker_pool serving actor creations, worker_pool.h PopWorker).
+            # The span parents to the driver's actor_launch span via the
+            # entry's propagated trace_ctx (VERDICT: the per-phase launch
+            # breakdown `ray-tpu timeline` surfaces).
             env_key = self._env_key(entry)
-            with self._workers_lock:
-                w = self._pop_idle_locked(env_key)
-                if w is not None:
-                    w.actor_id = entry["actor_id"]
-            if w is None:
-                w = self._spawn_worker(
-                    actor_id=entry["actor_id"],
-                    env_key=env_key,
-                    runtime_env=entry.get("runtime_env"),
-                )
+            with _tracing.continue_context(
+                entry.get("trace_ctx"),
+                "actor_launch.worker_spawn",
+                {"actor_id": entry.get("actor_id", "")},
+            ) as sp:
+                with self._workers_lock:
+                    w = self._pop_idle_locked(env_key)
+                    if w is not None:
+                        w.actor_id = entry["actor_id"]
+                if w is None:
+                    w = self._spawn_worker(
+                        actor_id=entry["actor_id"],
+                        env_key=env_key,
+                        runtime_env=entry.get("runtime_env"),
+                    )
+                    if sp is not None:
+                        sp["attrs"]["mode"] = "spawned"
+                elif sp is not None:
+                    sp["attrs"]["mode"] = "pooled"
+            self._obs_dispatch(entry)
             with self._actor_lock:
                 a = self._actors.get(entry["actor_id"])
                 if a is not None:
@@ -1619,6 +1668,7 @@ class RayletService:
             # serially (reference: actor_scheduling_queue.h ordered queue).
             with self._actor_lock:
                 a["inflight"].append(entry)
+            self._obs_dispatch(entry)
             self._task_event(entry["task_id"], "RUNNING")
             w.mailbox.put({"type": "task", "entry": entry})
             return True
@@ -1717,7 +1767,14 @@ class RayletService:
                 if self._stop.is_set():
                     return
                 with self._workers_lock:
-                    self._spawn_worker_locked(env_key="")
+                    w = self._spawn_worker_locked(env_key="")
+                    # Prestarted workers MUST enter the idle pool: they are
+                    # otherwise invisible to _checkout_worker while still
+                    # counting against _max_task_workers — a prestart that
+                    # fills the cap before the first submit would leave the
+                    # node unable to dispatch anything, ever.
+                    self._idle.setdefault("", []).append(w.worker_id)
+            self._sched_wake.set()  # fresh pool may unblock queued work
         except Exception as e:  # noqa: BLE001
             print(f"raylet: worker prestart failed: {e!r}", file=sys.stderr, flush=True)
 
@@ -1787,9 +1844,14 @@ class RayletService:
             # so import-time vars (JAX_*, RAY_TPU_* config) set after the
             # fork would silently not take effect; those envs Popen.
             try:
+                t0 = time.perf_counter()
                 pid = zygote.spawn(
                     worker_args, env, log_base + ".out", log_base + ".err"
                 )
+                imet.ZYGOTE_FORK_LATENCY.observe(
+                    (time.perf_counter() - t0) * 1e3, mode="zygote"
+                )
+                imet.WORKER_SPAWN_TOTAL.inc(mode="zygote")
                 from .zygote import PidHandle
 
                 w = _Worker(worker_id, PidHandle(pid), env_key=env_key)
@@ -1819,12 +1881,17 @@ class RayletService:
                     expanded.append(part)
             argv = expanded + argv
         try:
+            t0 = time.perf_counter()
             proc = subprocess.Popen(
                 argv,
                 env=env,
                 stdout=out_f,
                 stderr=err_f,
             )
+            imet.ZYGOTE_FORK_LATENCY.observe(
+                (time.perf_counter() - t0) * 1e3, mode="popen"
+            )
+            imet.WORKER_SPAWN_TOTAL.inc(mode="popen")
         finally:
             out_f.close()
             err_f.close()
@@ -1901,8 +1968,7 @@ class RayletService:
                         self._task_event(
                             entry["task_id"], "QUEUED", retry=entry["attempt"]
                         )
-                        self._pending.put(entry)
-                        self._sched_wake.set()
+                        self._enqueue(entry)
                     else:
                         self._store_error_for(
                             entry,
@@ -1966,8 +2032,15 @@ class RayletService:
                 avail = dict(self.available)
             with self._workers_lock:
                 n_workers = len(self._workers)
+                n_busy = sum(
+                    1 for w in self._workers.values() if w.busy_with is not None
+                )
+                n_idle = sum(len(v) for v in self._idle.values())
             with self._spill_lock:
                 n_spilled = len(self._spilled)
+            imet.WORKER_POOL_IDLE.set(n_idle)
+            imet.WORKER_POOL_BUSY.set(n_busy)
+            imet.WORKER_POOL_LEASED.set(len(self._leases))
             stats = {
                 "bytes_in_use": self.store.bytes_in_use(),
                 "num_objects": self.store.num_objects(),

@@ -114,6 +114,10 @@ class RpcServer:
                         )
                     ):
                         return  # drop unauthenticated connections
+                # Per-method count/latency hook (only services that define
+                # _observe_rpc pay for it — the GCS does, raylets do not,
+                # keeping the task fast path free of timing calls).
+                observe = getattr(server_self.service, "_observe_rpc", None)
                 while True:
                     try:
                         raw = _recv_msg(sock)
@@ -126,11 +130,18 @@ class RpcServer:
                         # One-way notification: execute without replying
                         # (the submit fast path; errors surface as stored
                         # error objects, not RPC failures).
+                        t0 = time.perf_counter() if observe else 0.0
                         try:
                             getattr(server_self.service, method)(*args, **kwargs)
                         except BaseException:  # noqa: BLE001
                             pass
+                        if observe:
+                            try:
+                                observe(method, (time.perf_counter() - t0) * 1e3)
+                            except Exception:
+                                pass
                         continue
+                    t0 = time.perf_counter() if observe else 0.0
                     try:
                         fn = getattr(server_self.service, method)
                         result = fn(*args, **kwargs)
@@ -140,6 +151,11 @@ class RpcServer:
                             reply = pickle.dumps((req_id, False, e))
                         except Exception:
                             reply = pickle.dumps((req_id, False, RuntimeError(repr(e))))
+                    if observe:
+                        try:
+                            observe(method, (time.perf_counter() - t0) * 1e3)
+                        except Exception:
+                            pass
                     try:
                         _send_msg(sock, reply)
                     except (ConnectionError, OSError):

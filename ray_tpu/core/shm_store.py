@@ -288,7 +288,17 @@ class SharedMemoryStore:
             raise OSError(-rc, "rtpu_get failed")
         # Readers get read-only views: pool objects are immutable after seal.
         pin = _Pin(self, oid.binary(), self._mv[off.value : off.value + size.value].toreadonly())
-        value, n_oob = serialization.unpack_info(memoryview(pin))
+        try:
+            view = memoryview(pin)  # PEP 688: pin rides the buffer chain
+        except TypeError:
+            # Python < 3.12 has no pure-python __buffer__: nothing can tie
+            # the pin's lifetime to reconstructed arrays, so deserialize
+            # from a COPY (correctness over zero-copy) and unpin.
+            data = bytes(pin.slice(0, size.value))
+            pin.release()
+            value, _ = serialization.unpack_info(data)
+            return value
+        value, n_oob = serialization.unpack_info(view)
         if n_oob == 0:
             pin.release()  # nothing aliases the pool; unpin now
         return value

@@ -156,6 +156,11 @@ def main(argv: List[str]) -> None:
     )
     runtime._worker_id = worker_id
     runtime_base.set_runtime(runtime)
+    from ..utils import internal_metrics as _imet
+
+    # Library metrics recorded in this worker (serve/data/train/rl) flush
+    # through the runtime's GCS client, labeled with this node's id.
+    _imet.configure(node_id=node_id, reporter=worker_id)
 
     actor_instance: Dict[str, Any] = {}  # actor_id -> instance
 
@@ -468,13 +473,22 @@ def main(argv: List[str]) -> None:
 
     def create_actor(entry: dict, sealed: List[str]) -> bool:
         nonlocal pool, aio
+        from .. import tracing as _tracing
         from .runtime_context import set_task_context
 
         set_task_context(entry.get("task_id"), entry.get("actor_id"))
         try:
             cls = GLOBAL_FUNCTION_TABLE.loads(entry["func_blob"], entry["func_hash"])
             args, kwargs = _resolve_args(store, entry["args_blob"], raylet)
-            inst = cls(*args, **kwargs)
+            # The final actor-launch phase: constructor execution in the
+            # (possibly freshly forked) worker, parented to the driver's
+            # actor_launch span via the propagated context.
+            with _tracing.continue_context(
+                entry.get("trace_ctx"),
+                "actor_launch.init",
+                {"actor_id": entry.get("actor_id", "")},
+            ):
+                inst = cls(*args, **kwargs)
             actor_instance[entry["actor_id"]] = inst
             mc = int(entry.get("max_concurrency", 1) or 1)
             cgroups = entry.get("concurrency_groups") or {}

@@ -79,19 +79,64 @@ def _prom_tags(tags: Dict[str, Any]) -> str:
     return "{" + inner + "}"
 
 
-def prometheus_text(stats: dict, user_metrics: list) -> str:
-    """Prometheus text exposition of runtime + user metrics (reference:
-    _private/metrics_agent.py:483 — the OpenCensus->Prometheus exporter
-    every node agent runs; here one cluster-level scrape target)."""
-    lines = []
+def prometheus_text(
+    stats: dict,
+    user_metrics: list,
+    internal_metrics: Optional[list] = None,
+    help_texts: Optional[Dict[str, str]] = None,
+) -> str:
+    """Prometheus text exposition of runtime + internal + user metrics
+    (reference: _private/metrics_agent.py:483 — the OpenCensus->Prometheus
+    exporter every node agent runs; here one cluster-level scrape target).
+
+    Exposition-format correctness the parser round-trip test pins down:
+    label values escape `\\`, `"`, and newlines; `# TYPE`/`# HELP` appear
+    exactly ONCE per metric family even when a name has many tag sets or
+    appears in both the internal and user tables; histogram series carry
+    the `_bucket`/`_sum`/`_count` suffixes with a closing `+Inf` bucket."""
+    families: "Dict[str, dict]" = {}
+    order: list = []
+
+    def _family(name: str, mtype: str, help_text: str = ""):
+        pname = _prom_name(name)
+        fam = families.get(pname)
+        if fam is None:
+            fam = {"type": mtype, "help": help_text, "lines": []}
+            families[pname] = fam
+            order.append(pname)
+        elif fam["type"] != mtype:
+            return None, None  # kind collision: first declaration wins
+        if help_text and not fam["help"]:
+            fam["help"] = help_text
+        return pname, fam
 
     def emit(name, mtype, samples, help_text=""):
-        name = _prom_name(name)
-        if help_text:
-            lines.append(f"# HELP {name} {help_text}")
-        lines.append(f"# TYPE {name} {mtype}")
+        pname, fam = _family(name, mtype, help_text)
+        if fam is None:
+            return
         for tags, val in samples:
-            lines.append(f"{name}{_prom_tags(tags)} {val}")
+            fam["lines"].append(f"{pname}{_prom_tags(tags)} {val}")
+
+    def emit_histogram(name, entries, help_text=""):
+        pname, fam = _family(name, "histogram", help_text)
+        if fam is None:
+            return
+        for e in entries:
+            tags = e.get("tags") or {}
+            bounds = e.get("boundaries") or []
+            counts = e.get("counts") or []
+            cum = 0
+            for b, c in zip(bounds, counts):
+                cum += c
+                fam["lines"].append(
+                    f"{pname}_bucket{_prom_tags({**tags, 'le': b})} {cum}"
+                )
+            total = sum(counts)
+            fam["lines"].append(
+                f"{pname}_bucket{_prom_tags({**tags, 'le': '+Inf'})} {total}"
+            )
+            fam["lines"].append(f"{pname}_sum{_prom_tags(tags)} {e.get('value', 0.0)}")
+            fam["lines"].append(f"{pname}_count{_prom_tags(tags)} {total}")
 
     emit("ray_tpu_nodes_alive", "gauge", [({}, stats.get("nodes_alive", 0))],
          "Alive raylet count")
@@ -110,32 +155,37 @@ def prometheus_text(stats: dict, user_metrics: list) -> str:
     emit("ray_tpu_placement_groups", "gauge",
          [({}, stats.get("placement_groups", 0))])
 
+    helps = dict(help_texts or {})
     by_name: Dict[str, list] = {}
-    for m in user_metrics:
+    for m in list(internal_metrics or []) + list(user_metrics or []):
         by_name.setdefault(m["name"], []).append(m)
     for name, entries in sorted(by_name.items()):
         kind = entries[0].get("kind")
+        # Kind collision inside one family (e.g. a user metric reusing an
+        # internal name with a different kind): first declaration wins,
+        # mismatched samples are dropped rather than mislabeled.
+        entries = [e for e in entries if e.get("kind") == kind]
+        help_text = helps.get(name, "")
         if kind == "counter":
-            emit(name, "counter", [(e.get("tags") or {}, e.get("value", 0.0)) for e in entries])
+            emit(name, "counter",
+                 [(e.get("tags") or {}, e.get("value", 0.0)) for e in entries],
+                 help_text)
         elif kind == "gauge":
-            emit(name, "gauge", [(e.get("tags") or {}, e.get("value", 0.0)) for e in entries])
+            emit(name, "gauge",
+                 [(e.get("tags") or {}, e.get("value", 0.0)) for e in entries],
+                 help_text)
         elif kind == "histogram":
-            pname = _prom_name(name)
-            lines.append(f"# TYPE {pname} histogram")
-            for e in entries:
-                tags = e.get("tags") or {}
-                bounds = e.get("boundaries") or []
-                counts = e.get("counts") or []
-                cum = 0
-                for b, c in zip(bounds, counts):
-                    cum += c
-                    lines.append(
-                        f"{pname}_bucket{_prom_tags({**tags, 'le': b})} {cum}"
-                    )
-                total = sum(counts)
-                lines.append(f"{pname}_bucket{_prom_tags({**tags, 'le': '+Inf'})} {total}")
-                lines.append(f"{pname}_sum{_prom_tags(tags)} {e.get('value', 0.0)}")
-                lines.append(f"{pname}_count{_prom_tags(tags)} {total}")
+            emit_histogram(name, entries, help_text)
+
+    lines = []
+    for pname in order:
+        fam = families[pname]
+        if fam["help"]:
+            # HELP text is a raw escape context: backslash and newline only.
+            help_esc = fam["help"].replace("\\", "\\\\").replace("\n", "\\n")
+            lines.append(f"# HELP {pname} {help_esc}")
+        lines.append(f"# TYPE {pname} {fam['type']}")
+        lines.extend(fam["lines"])
     return "\n".join(lines) + "\n"
 
 
@@ -164,6 +214,8 @@ class _Dashboard:
                 return gcs.call("stats")
             if path == "metrics":
                 return gcs.call("user_metrics")
+            if path == "internal_metrics":
+                return gcs.call("internal_metrics")
             if path == "jobs":
                 from .jobs import list_job_records
 
@@ -201,8 +253,17 @@ class _Dashboard:
                     # Prometheus text exposition (reference:
                     # metrics_agent.py:483 Prometheus exporter).
                     try:
+                        from .utils import internal_metrics as _imet
+
+                        try:
+                            internal = gcs.call("internal_metrics")
+                        except Exception:
+                            internal = []  # pre-upgrade GCS: user-only
                         text = prometheus_text(
-                            gcs.call("stats"), gcs.call("user_metrics")
+                            gcs.call("stats"),
+                            gcs.call("user_metrics"),
+                            internal,
+                            _imet.help_texts(),
                         )
                         self._reply(
                             200, text.encode(), "text/plain; version=0.0.4"

@@ -81,8 +81,16 @@ class _Op:
 
 def _apply_fused(block: Block, ops: List[_Op]) -> Block:
     """Runs a fused chain of transforms on one block inside a task."""
+    from ..utils import internal_metrics as imet
+
     for op in ops:
         acc = BlockAccessor(block)
+        # Worker-side per-operator rows/s (rate over the flushed counter);
+        # counts INPUT rows — the work the operator actually performed.
+        try:
+            imet.DATA_ROWS.inc(acc.num_rows(), operator=op.kind)
+        except Exception:
+            pass
         if op.kind == "map_rows":
             block = block_from_rows([op.fn(r) for r in acc.iter_rows()])
         elif op.kind == "filter":

@@ -31,6 +31,7 @@ from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 from .. import api
+from ..utils import internal_metrics as imet
 
 # Sentinel marking end-of-stream on the consumer queue.
 _DONE = object()
@@ -194,10 +195,14 @@ class StreamingExecutor:
                     op.done[seq] = op.pending.pop(seq)
                 op.tasks_finished += len(done)
             # Release strictly in input order.
+            released = 0
             while op.next_out in op.done:
                 op.outqueue.append(op.done.pop(op.next_out))
                 op.next_out += 1
+                released += 1
                 moved = True
+            if released:
+                imet.DATA_OP_BLOCKS.inc(released, operator=op.name)
         return moved
 
     def _transfer(self) -> None:
@@ -269,6 +274,7 @@ class StreamingExecutor:
         op.pending[op.next_seq] = op.submit(ref)
         op.next_seq += 1
         op.tasks_started += 1
+        imet.DATA_OP_TASKS.inc(operator=op.name)
 
     def _all_done(self) -> bool:
         if not self._source_done:

@@ -52,7 +52,10 @@ def axis_index(axis: str):
 
 
 def axis_size(axis: str):
-    return lax.axis_size(axis)
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    # jax < 0.5 spelling: psum of a literal folds to the static axis size.
+    return lax.psum(1, axis)
 
 
 def ring_permute(x: Any, axis: str, *, shift: int = 1):
@@ -61,13 +64,13 @@ def ring_permute(x: Any, axis: str, *, shift: int = 1):
     On TPU a unit-shift ppermute is a single-hop ICI transfer — the building
     block of ring attention and pipeline microbatch rotation.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
 
 def one_hot_rank(axis: str, n: Optional[int] = None, dtype=jnp.float32):
-    n = n if n is not None else lax.axis_size(axis)
+    n = n if n is not None else axis_size(axis)
     return jax.nn.one_hot(lax.axis_index(axis), n, dtype=dtype)
 
 
@@ -90,6 +93,14 @@ def shard_map(
     """`jax.shard_map` with the framework mesh (per-shard programming model
     for kernels that need explicit collectives — ring attention, Ulysses,
     expert dispatch)."""
-    return jax.shard_map(
-        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+    # jax < 0.5: the API lives in jax.experimental and the vma flag is
+    # spelled check_rep (inverted default, same meaning for our uses).
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
     )

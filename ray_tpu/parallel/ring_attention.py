@@ -27,7 +27,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec
 
 from ..ops.flash_attention import flash_attention_with_lse
-from .collectives import shard_map
+from .collectives import axis_size, shard_map
 
 NEG_INF = -1e30
 
@@ -52,7 +52,7 @@ def _ring_attention_shard(q, k, v, *, axis: str, causal: bool, scale: float):
     (the flash kernel returns lse; gradient flows through it via
     _flash_lse's custom VJP).
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     rank = lax.axis_index(axis)
     # Receive from rank+1 side: after i rotations we hold block (rank+i)%n.
     perm = [(j, (j - 1) % n) for j in range(n)]

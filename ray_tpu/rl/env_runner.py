@@ -92,9 +92,14 @@ class SingleAgentEnvRunner:
     def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
         """Rollout num_steps per env; returns [T, N, ...] arrays
         (reference: sample() :134)."""
+        import time as _time
+
         import jax
 
+        from ..utils import internal_metrics as imet
+
         assert self._params is not None, "set_weights before sample"
+        sample_t0 = _time.perf_counter()
         T, N = num_steps, self.num_envs
         obs_buf = np.zeros((T, N) + self._obs.shape[1:], np.float32)
         if self.module.action_kind == "continuous":
@@ -158,6 +163,10 @@ class SingleAgentEnvRunner:
             if "vf" in last_out
             else np.zeros((N,), np.float32)
         )
+        # Sample throughput telemetry: env-steps/s is the rate of this
+        # counter; the histogram shows per-call wall time.
+        imet.RL_ENV_STEPS.inc(T * N)
+        imet.RL_SAMPLE_TIME.observe((_time.perf_counter() - sample_t0) * 1e3)
         return {
             "obs": obs_buf,
             "actions": act_buf,

@@ -211,12 +211,57 @@ def cmd_logs(args) -> None:
     sys.stdout.write(JobSubmissionClient().get_job_logs(args.job_id))
 
 
+def format_metrics_table(sections) -> str:
+    """Renders aggregated metric records as one aligned table with a
+    header; `sections` is [(source, records), ...] (shared by
+    `ray-tpu metrics` and its test)."""
+    rows = [("SOURCE", "NAME", "KIND", "TAGS", "VALUE")]
+    for source, records in sections:
+        for m in sorted(
+            records, key=lambda r: (r.get("name", ""), str(r.get("tags")))
+        ):
+            tags = m.get("tags") or {}
+            tag_str = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+            val = m.get("value", 0.0)
+            if m.get("kind") == "histogram":
+                count = sum(m.get("counts") or [])
+                val = f"sum={val:g} count={count}"
+            else:
+                val = f"{val:g}"
+            rows.append(
+                (source, m.get("name", "?"), m.get("kind", "?"), tag_str, val)
+            )
+    # Header participates in the width computation so it stays aligned.
+    widths = [max(len(r[i]) for r in rows) for i in range(4)]
+    return "\n".join(
+        "  ".join(col.ljust(w) for col, w in zip(r[:4], widths)) + "  " + r[4]
+        for r in rows
+    )
+
+
+def cmd_metrics(args) -> None:
+    _connect(args)
+    from .utils import state
+
+    internal = state.internal_metrics()
+    user = state.user_metrics()
+    print(format_metrics_table([("internal", internal), ("user", user)]))
+    print(f"\n{len(internal)} internal + {len(user)} user metric series")
+
+
 def cmd_timeline(args) -> None:
     _connect(args)
     from .utils import state
 
     events = state.timeline(args.out)
-    print(f"wrote {len(events)} task spans to {args.out} (open in Perfetto)")
+    n_spans = sum(1 for e in events if e.get("cat") == "span")
+    extra = f" (+{n_spans} trace spans)" if n_spans else ""
+    print(f"wrote {len(events)} task spans{extra} to {args.out} (open in Perfetto)")
+    if not n_spans:
+        print(
+            "hint: run the workload with RAY_TPU_TRACING=1 to include "
+            "runtime spans (actor-launch phase breakdown)"
+        )
 
 
 def cmd_dashboard(args) -> None:
@@ -284,6 +329,12 @@ def main(argv=None) -> None:
     p.add_argument("--address", default=None)
     p.add_argument("job_id")
     p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser(
+        "metrics", help="dump current internal + user metrics as a table"
+    )
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("dashboard", help="serve the cluster dashboard")
     p.add_argument("--address", default=None)

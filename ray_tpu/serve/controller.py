@@ -27,7 +27,7 @@ class Replica:
 
     STREAM_MARKER = "__ray_tpu_stream__"
 
-    def __init__(self, cls_blob: bytes, init_args, init_kwargs):
+    def __init__(self, cls_blob: bytes, init_args, init_kwargs, app_name: str = ""):
         import cloudpickle
 
         target = cloudpickle.loads(cls_blob)
@@ -35,9 +35,17 @@ class Replica:
             self._callable = target(*init_args, **init_kwargs)
         else:
             self._callable = target
+        self._app_name = app_name
         self._ongoing = 0
         self._lock = threading.Lock()
         self._total = 0
+        # Per-deployment request latency + QPS (reference:
+        # serve_deployment_processing_latency_ms in metric_defs/serve
+        # metrics; flushed via the worker's internal-metrics pipeline).
+        from ..utils import internal_metrics as imet
+
+        self._m_requests = imet.SERVE_REQUESTS.labels(deployment=app_name)
+        self._m_latency = imet.SERVE_REQUEST_LATENCY.labels(deployment=app_name)
         # Streaming responses: generator outputs run in a background thread
         # into a bounded queue, pulled chunk-wise by the caller (reference:
         # replica.py handle_request_streaming over the streaming generator
@@ -50,11 +58,14 @@ class Replica:
         import asyncio
         import inspect
         import queue as _queue
+        import time as _time
         import uuid
 
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        self._m_requests.inc()
+        req_t0 = _time.perf_counter()
         streaming = False
         try:
             # Per-request context (multiplexed model id etc.) for
@@ -89,6 +100,8 @@ class Replica:
                     with self._lock:
                         self._ongoing -= 1
                     self._streams.pop(stream_id, None)
+                    # Stream latency covers first byte to drain completion.
+                    self._m_latency.observe((_time.perf_counter() - req_t0) * 1e3)
 
                 def put_or_abandon(item) -> bool:
                     try:
@@ -128,6 +141,7 @@ class Replica:
             if not streaming:
                 with self._lock:
                     self._ongoing -= 1
+                self._m_latency.observe((_time.perf_counter() - req_t0) * 1e3)
 
     def handle_request_stream(self, method: str, args, kwargs, context=None):
         """Streaming request path: runs as a num_returns="streaming" actor
@@ -137,10 +151,13 @@ class Replica:
         runtime primitive instead of a bespoke pull protocol)."""
         import asyncio
         import inspect
+        import time as _time
 
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        self._m_requests.inc()
+        req_t0 = _time.perf_counter()
         try:
             from .batching import set_request_context
 
@@ -170,6 +187,7 @@ class Replica:
         finally:
             with self._lock:
                 self._ongoing -= 1
+            self._m_latency.observe((_time.perf_counter() - req_t0) * 1e3)
 
     def next_chunks(self, stream_id: str, max_n: int = 8, timeout: float = 2.0):
         """Pulls up to max_n chunks; returns (chunks, done). Short blocking
@@ -340,7 +358,9 @@ class ServeController:
             changed = False
             created = []
             while len(current) < target:
-                r = replica_cls.remote(spec["cls_blob"], spec["init_args"], spec["init_kwargs"])
+                r = replica_cls.remote(
+                    spec["cls_blob"], spec["init_args"], spec["init_kwargs"], name
+                )
                 current.append(r)
                 created.append(r)
                 changed = True

@@ -50,9 +50,11 @@ class TrainSession:
         self._result_queue: "queue.Queue" = queue.Queue(maxsize=1)
         self._finished = threading.Event()
         self._cancelled = threading.Event()
+        self._last_report_ts: Optional[float] = None
 
     # ------------------------------------------------------------ user API
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+        self._observe_report(metrics)
         payload = {"metrics": dict(metrics), "checkpoint": checkpoint}
         while True:
             if self._cancelled.is_set():
@@ -62,6 +64,31 @@ class TrainSession:
                 return
             except queue.Full:
                 continue
+
+    def _observe_report(self, metrics: Dict[str, Any]) -> None:
+        """Internal train telemetry: report-to-report interval is the step
+        time of the training loop, and recognized throughput keys
+        (tokens_per_s, mfu) mirror into cluster gauges so `/metrics` shows
+        pod saturation without user-defined metrics (PAPERS: Podracer /
+        pjit-at-scale both steer on step-time + MFU)."""
+        import time as _time
+
+        from ..utils import internal_metrics as imet
+
+        now = _time.monotonic()
+        imet.TRAIN_REPORTS.inc()
+        if self._last_report_ts is not None:
+            imet.TRAIN_STEP_TIME.observe((now - self._last_report_ts) * 1e3)
+        self._last_report_ts = now
+        trial = self.trial_name or "default"
+        rank = str(self.world_rank)
+        for key, gauge in (
+            ("tokens_per_s", imet.TRAIN_TOKENS_PER_S),
+            ("mfu", imet.TRAIN_MFU),
+        ):
+            v = metrics.get(key)
+            if isinstance(v, (int, float)):
+                gauge.set(float(v), trial=trial, rank=rank)
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self._starting_checkpoint

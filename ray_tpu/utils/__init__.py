@@ -4,7 +4,8 @@
 from . import state
 from .config import CONFIG, RayTpuConfig, all_flags
 
-__all__ = ["CONFIG", "RayTpuConfig", "all_flags", "state", "ActorPool", "Queue", "Empty", "Full", "metrics"]
+__all__ = ["CONFIG", "RayTpuConfig", "all_flags", "state", "ActorPool", "Queue", "Empty", "Full", "metrics", "internal_metrics"]
+from . import internal_metrics  # noqa: F401
 from . import metrics  # noqa: F401
 from .actor_pool import ActorPool  # noqa: F401
 from .queue import Empty, Full, Queue  # noqa: F401

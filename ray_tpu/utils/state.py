@@ -64,6 +64,15 @@ def user_metrics() -> List[Dict[str, Any]]:
     return _gcs().call("user_metrics")
 
 
+def internal_metrics() -> List[Dict[str, Any]]:
+    """Cluster-aggregated RUNTIME-internal metrics (scheduler, worker
+    pool, zygote, GCS RPCs, object transport, reporter gauges, library
+    throughput — ray_tpu.utils.internal_metrics; reference:
+    src/ray/stats/metric_defs.cc). Every record carries `component` and
+    `node_id` tags."""
+    return _gcs().call("internal_metrics")
+
+
 def get_task(task_id: str) -> Optional[Dict[str, Any]]:
     return _gcs().call("get_task_states", [task_id]).get(task_id)
 
@@ -72,7 +81,10 @@ def timeline(path: Optional[str] = None) -> Any:
     """Chrome-trace (Perfetto/chrome://tracing) export of task execution
     spans (reference: `ray timeline`, python/ray/_private/state.py
     chrome_tracing_dump). Returns the event list; writes JSON when `path`
-    is given."""
+    is given. With tracing enabled (RAY_TPU_TRACING=1) the collected
+    trace spans merge in too — including the actor-launch phases
+    (gcs_register -> submit -> worker_spawn -> init), so a slow launch
+    decomposes visually instead of showing as one opaque gap."""
     import json
 
     events = []
@@ -97,6 +109,28 @@ def timeline(path: Optional[str] = None) -> Any:
                     }
                 )
                 start = None
+    from .. import tracing
+
+    for sp in tracing.collect():
+        start_us = sp.get("start_us")
+        if start_us is None:
+            continue
+        events.append(
+            {
+                "name": sp.get("name", "span"),
+                "cat": "span",
+                "ph": "X",
+                "ts": start_us,
+                "dur": max(0.0, sp.get("end_us", start_us) - start_us),
+                "pid": f"proc:{sp.get('pid', '?')}",
+                "tid": (sp.get("trace_id") or "")[:8],
+                "args": {
+                    "span_id": sp.get("span_id"),
+                    "parent_id": sp.get("parent_id"),
+                    **(sp.get("attrs") or {}),
+                },
+            }
+        )
     if path:
         with open(path, "w") as f:
             json.dump(events, f)

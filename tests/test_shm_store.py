@@ -31,6 +31,13 @@ def test_put_get_roundtrip(store):
 
 
 def test_numpy_zero_copy(store):
+    import sys
+
+    if sys.version_info < (3, 12):
+        # Zero-copy reads ride _Pin.__buffer__ (PEP 688, 3.12+); older
+        # interpreters take the safe copy fallback in store.get, where
+        # the alias-pin contract below cannot hold by construction.
+        pytest.skip("zero-copy pinning requires PEP 688 (python >= 3.12)")
     oid = _oid()
     arr = np.arange(1 << 20, dtype=np.float32)
     store.put(oid, arr)
