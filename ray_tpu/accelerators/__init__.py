@@ -1,0 +1,127 @@
+"""ray_tpu.accelerators: accelerator detection, visibility, provisioning.
+
+The registry half of the reference's accelerator package (reference:
+python/ray/_private/accelerators/__init__.py get_accelerator_manager_for_resource)
+plus the node-provider half of its autoscaler (node_provider.py ABC and
+the GCP impl) — fused into one subsystem because on TPU they are two ends
+of the same object: detection reads the slice a host belongs to,
+provisioning creates that slice.
+
+Resolution order for a resource name: the built-in family (TPU, CPU),
+then plugins registered via :func:`register_accelerator_manager` or the
+``RAY_TPU_ACCELERATOR_PLUGINS`` env var (``module:attr`` comma list —
+attr may be a manager class or instance). Nothing here touches the
+network or a JAX backend at import time.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from .accelerator import AcceleratorManager
+from .cpu import CpuAcceleratorManager
+from .node_provider import GceTpuNodeProvider, LocalNodeProvider, NodeProvider
+from .tpu import TpuAcceleratorManager, parse_pod_type
+
+__all__ = [
+    "AcceleratorManager",
+    "CpuAcceleratorManager",
+    "TpuAcceleratorManager",
+    "NodeProvider",
+    "LocalNodeProvider",
+    "GceTpuNodeProvider",
+    "parse_pod_type",
+    "register_accelerator_manager",
+    "get_accelerator_manager",
+    "all_accelerator_managers",
+    "detect_accelerators",
+    "detect_tpu_slice",
+]
+
+_registry: Dict[str, AcceleratorManager] = {}
+_plugins_loaded = False
+
+
+def register_accelerator_manager(
+    manager: AcceleratorManager, override: bool = False
+) -> None:
+    """Registers a manager under its resource name. Third-party families
+    (e.g. a GPU plugin) call this at import; `override` replaces a
+    built-in (tests swap in probe-stubbed TPU managers this way)."""
+    name = manager.get_resource_name()
+    if name in _registry and not override:
+        raise ValueError(f"accelerator manager for {name!r} already registered")
+    _registry[name] = manager
+
+
+def _ensure_builtin() -> None:
+    global _plugins_loaded
+    if "CPU" not in _registry:
+        _registry["CPU"] = CpuAcceleratorManager()
+    if "TPU" not in _registry:
+        _registry["TPU"] = TpuAcceleratorManager()
+    if not _plugins_loaded:
+        _plugins_loaded = True
+        for spec in filter(
+            None, os.environ.get("RAY_TPU_ACCELERATOR_PLUGINS", "").split(",")
+        ):
+            _load_plugin(spec.strip())
+
+
+def _load_plugin(spec: str) -> None:
+    """"module" (registers itself on import) or "module:attr"."""
+    import importlib
+
+    try:
+        mod_name, _, attr = spec.partition(":")
+        mod = importlib.import_module(mod_name)
+        if attr:
+            obj = getattr(mod, attr)
+            manager = obj() if isinstance(obj, type) else obj
+            register_accelerator_manager(manager, override=True)
+    except Exception as e:  # a broken plugin must not brick node startup
+        import sys
+
+        print(
+            f"ray_tpu.accelerators: plugin {spec!r} failed to load: {e!r}",
+            file=sys.stderr,
+        )
+
+
+def get_accelerator_manager(resource_name: str) -> Optional[AcceleratorManager]:
+    _ensure_builtin()
+    return _registry.get(resource_name)
+
+
+def all_accelerator_managers() -> List[AcceleratorManager]:
+    _ensure_builtin()
+    return list(_registry.values())
+
+
+def detect_accelerators() -> Dict[str, float]:
+    """resource name -> detected count for every family present on this
+    host (CPU excluded: callers own the CPU default/override policy)."""
+    out: Dict[str, float] = {}
+    for mgr in all_accelerator_managers():
+        name = mgr.get_resource_name()
+        if name == "CPU":
+            continue
+        try:
+            n = mgr.get_current_node_num_accelerators()
+        except Exception:
+            n = 0
+        if n:
+            out[name] = float(n)
+    return out
+
+
+def detect_tpu_slice():
+    """TpuSliceSpec for this host, or None (off-TPU / undetectable)."""
+    mgr = get_accelerator_manager("TPU")
+    if mgr is None or not hasattr(mgr, "detect_slice_spec"):
+        return None
+    try:
+        return mgr.detect_slice_spec()
+    except Exception:
+        return None

@@ -1,0 +1,91 @@
+"""AcceleratorManager: the per-accelerator-family detection/visibility ABC.
+
+Re-design of the reference's accelerator abstraction (reference:
+python/ray/_private/accelerators/accelerator.py — the all-staticmethod ABC
+every family implements and node startup consults). Two deliberate
+differences for the TPU-first runtime:
+
+* Managers are INSTANCES, not static namespaces, so probe inputs (device
+  dir, environment, metadata transport) are injectable — detection logic
+  is testable without a TPU VM and never hits the network in tests.
+* Slice topology is first-class: a manager may return a
+  :class:`~ray_tpu.core.resources.TpuSliceSpec`-shaped description of the
+  pod slice this host belongs to, which feeds the scheduler's SLICE_GANG
+  placement directly (reference approximates this with the
+  ``TPU-{pod}-head`` custom-resource idiom, accelerators/tpu.py:334-397).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+class AcceleratorManager:
+    """Detection + process-visibility contract for one accelerator family.
+
+    Node startup asks the registered managers three questions (how many
+    accelerators does this host have, what type are they, how are they
+    arranged) and the worker spawner a fourth (what environment makes a
+    child process see exactly this subset)."""
+
+    # ------------------------------------------------------------ identity
+    def get_resource_name(self) -> str:
+        """The resource string this family schedules under ("TPU", "GPU")."""
+        raise NotImplementedError
+
+    def get_visible_accelerator_ids_env_var(self) -> Optional[str]:
+        """Env var restricting which accelerators a process sees (the
+        family's CUDA_VISIBLE_DEVICES analogue), or None."""
+        return None
+
+    # ----------------------------------------------------------- detection
+    def get_current_node_num_accelerators(self) -> int:
+        """How many accelerators of this family the host carries."""
+        raise NotImplementedError
+
+    def get_current_node_accelerator_type(self) -> Optional[str]:
+        """The family-specific type string (e.g. a TPU pod type like
+        "v5litepod-16"), or None when undetectable."""
+        return None
+
+    def get_current_node_additional_resources(self) -> Dict[str, float]:
+        """Extra custom resources registration should carry (beyond the
+        family's count resource)."""
+        return {}
+
+    # ---------------------------------------------------------- validation
+    def validate_resource_request_quantity(
+        self, quantity: float
+    ) -> Tuple[bool, Optional[str]]:
+        """Whether a task may request `quantity` of this resource
+        (fractional chips are not shareable on most accelerators)."""
+        if quantity > 1 and not float(quantity).is_integer():
+            return (
+                False,
+                f"{self.get_resource_name()} requests over 1 must be whole "
+                f"numbers, got {quantity}",
+            )
+        return True, None
+
+    # ---------------------------------------------------------- visibility
+    def get_current_process_visible_accelerator_ids(self) -> Optional[List[str]]:
+        """Accelerator ids this process is restricted to (parsed from the
+        visibility env var), or None when unrestricted."""
+        return None
+
+    def set_current_process_visible_accelerators(self, ids: List[str]) -> None:
+        """Restricts THIS process (mutates os.environ) to `ids`."""
+        import os
+
+        for k, v in self.worker_visibility_env(ids).items():
+            os.environ[k] = v
+
+    def worker_visibility_env(self, ids: List[str], **extra) -> Dict[str, str]:
+        """Env vars a freshly spawned worker needs to see exactly `ids`
+        (the raylet injects these at spawn; reference:
+        accelerator.set_current_process_visible_accelerators but computed,
+        not applied, so it composes with subprocess env dicts)."""
+        var = self.get_visible_accelerator_ids_env_var()
+        if var is None:
+            return {}
+        return {var: ",".join(str(i) for i in ids)}

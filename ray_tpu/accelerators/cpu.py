@@ -1,0 +1,28 @@
+"""CpuAcceleratorManager: the always-available fallback family.
+
+CPU is modeled as an accelerator family (reference: the reference treats
+it specially in ray_params; here it rides the same registry) so node
+resource detection has exactly one code path — iterate managers, ask each
+for its count — with no special cases.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .accelerator import AcceleratorManager
+
+
+class CpuAcceleratorManager(AcceleratorManager):
+    def get_resource_name(self) -> str:
+        return "CPU"
+
+    def get_visible_accelerator_ids_env_var(self) -> Optional[str]:
+        return None  # CPU affinity is the OS scheduler's job, not env vars
+
+    def get_current_node_num_accelerators(self) -> int:
+        return os.cpu_count() or 1
+
+    def validate_resource_request_quantity(self, quantity: float):
+        return True, None  # fractional CPUs are fine (timesharing)

@@ -1,0 +1,124 @@
+"""GCE plumbing shared by TPU detection and the TPU-VM node provider.
+
+One injectable HTTP transport serves both the instance metadata server
+(topology discovery on a TPU VM, reference:
+python/ray/_private/accelerators/tpu.py _get_tpu_metadata) and the Cloud
+TPU REST API (slice provisioning, reference:
+python/ray/autoscaler/_private/gcp/node.py GCPTPUNode — which goes through
+googleapiclient; here a bare transport so tests stub the wire, not a SDK).
+No network call ever happens at import time.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional, Tuple
+
+# The metadata server's fixed link-local address (DNS-free: resolving
+# metadata.google.internal off-GCE can stall in some resolvers; the IP
+# fails fast with ECONNREFUSED/EHOSTUNREACH).
+GCE_METADATA_URL = "http://169.254.169.254/computeMetadata/v1"
+TPU_REST_URL = "https://tpu.googleapis.com/v2"
+
+# Metadata attribute paths a TPU VM exposes (reference: tpu.py
+# ACCELERATOR_TYPE/AGENT_WORKER_NUMBER attributes read the same way).
+ACCEL_TYPE_ATTR = "instance/attributes/accelerator-type"
+WORKER_NUMBER_ATTR = "instance/attributes/agent-worker-number"
+INSTANCE_ID_ATTR = "instance/attributes/instance-id"
+TOPOLOGY_ATTR = "instance/attributes/topology"
+
+
+class HttpTransport:
+    """The injectable wire. `request` returns (status_code, body_text);
+    transport-level failures return (0, ""). Tests replace this whole
+    object, so nothing above it ever needs patching."""
+
+    def request(
+        self,
+        method: str,
+        url: str,
+        body: Optional[dict] = None,
+        headers: Optional[Dict[str, str]] = None,
+        timeout: float = 10.0,
+    ) -> Tuple[int, str]:
+        import urllib.error
+        import urllib.request
+
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(
+            url, data=data, headers=dict(headers or {}), method=method
+        )
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, resp.read().decode(errors="replace")
+        except urllib.error.HTTPError as e:
+            try:
+                detail = e.read().decode(errors="replace")
+            except Exception:
+                detail = ""
+            return e.code, detail
+        except Exception:
+            return 0, ""
+
+
+_on_gce: Optional[bool] = None
+
+
+def probably_on_gce() -> bool:
+    """Cheap local check (no network): GCE/GKE machines expose the vendor
+    in DMI, and some environments set GCE_METADATA_HOST. Used to skip the
+    metadata HTTP probe entirely off-cloud — on networks that blackhole
+    link-local traffic the connect would otherwise block the full timeout
+    in every process that detects node resources."""
+    global _on_gce
+    if _on_gce is None:
+        import os
+
+        if os.environ.get("GCE_METADATA_HOST"):
+            _on_gce = True
+        else:
+            try:
+                with open("/sys/class/dmi/id/product_name") as f:
+                    _on_gce = f.read().startswith("Google")
+            except OSError:
+                _on_gce = False
+    return _on_gce
+
+
+def gce_metadata(
+    path: str, transport: Optional[HttpTransport] = None, timeout: float = 0.5
+) -> Optional[str]:
+    """One metadata attribute, or None when absent / off-GCE. The short
+    default timeout keeps node startup snappy off-cloud (the probe runs
+    once per raylet boot, not per task)."""
+    if transport is None or type(transport) is HttpTransport:
+        # Real wire: don't even dial the link-local address off-GCE.
+        # Injected transports (tests, recorded fixtures) always proceed.
+        if not probably_on_gce():
+            return None
+    transport = transport or HttpTransport()
+    status, body = transport.request(
+        "GET",
+        f"{GCE_METADATA_URL}/{path}",
+        headers={"Metadata-Flavor": "Google"},
+        timeout=timeout,
+    )
+    if status != 200:
+        return None
+    return body.strip() or None
+
+
+def gce_access_token(transport: Optional[HttpTransport] = None) -> Optional[str]:
+    """The default service account's OAuth token from the metadata server
+    (how a TPU VM authenticates REST calls without key files)."""
+    body = gce_metadata(
+        "instance/service-accounts/default/token", transport, timeout=5.0
+    )
+    if body is None:
+        return None
+    try:
+        return json.loads(body).get("access_token")
+    except (ValueError, AttributeError):
+        return None

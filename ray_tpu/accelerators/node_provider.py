@@ -1,0 +1,372 @@
+"""NodeProvider ABC + Local (subprocess) and GCE TPU-VM (REST) providers.
+
+Re-design of the reference's provider split (reference:
+python/ray/autoscaler/node_provider.py:13 NodeProvider ABC;
+_private/gcp/node_provider.py + gcp/node.py GCPTPUNode for the TPU REST
+resource; _private/fake_multi_node/node_provider.py:236 the test double).
+Differences, per the v2 reconciler's contract (ray_tpu/autoscaler_v2.py):
+
+* The ABC is ASYNC-shaped: `request` returns a handle immediately and
+  `poll` reports the cloud's view; the reconciler converges the
+  difference. The reference's blocking create_node hides allocation
+  latency inside provider calls.
+* A TPU pod slice is ONE unit: `request` of a multi-host shape creates
+  the whole slice atomically (one REST node resource on GCE; N raylet
+  subprocesses with shared slice labels locally) and any partial result
+  is torn down — a partial slice is useless to a gang.
+* Labels flow: the provider stamps each instance with a cloud-id label,
+  the startup script registers the raylet carrying it, and
+  `ray_node_for` matches cloud instance -> ray node through the GCS —
+  closing the loop the reconciler needs for RAY_RUNNING.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional
+
+from .gce import TPU_REST_URL, HttpTransport, gce_access_token
+from .tpu import parse_pod_type
+
+
+class NodeProvider:
+    """Async provider ABC the autoscaler-v2 InstanceManager drives. The
+    method set is CloudProvider-compatible (autoscaler_v2.py) so every
+    implementation plugs straight into the reconciler."""
+
+    def request(self, instance) -> str:
+        """Begins allocating `instance` (an autoscaler_v2.Instance-shaped
+        object: .instance_id, .shape); returns the provider's cloud id.
+        Multi-host shapes (shape["slice_hosts"] > 1) allocate atomically."""
+        raise NotImplementedError
+
+    def poll(self) -> Dict[str, str]:
+        """cloud_id -> "pending" | "running" | "failed" | "gone"."""
+        raise NotImplementedError
+
+    def terminate(self, cloud_id: str) -> None:
+        raise NotImplementedError
+
+    def ray_node_for(self, cloud_id: str) -> Optional[str]:
+        """The ray node id running on the instance (worker 0 of a slice),
+        once every host of it has joined; None before then."""
+        return None
+
+    def node_labels(self, cloud_id: str) -> Dict[str, str]:
+        """Labels the provider stamped on this instance's node(s)."""
+        return {}
+
+
+class LocalNodeProvider(NodeProvider):
+    """Real multi-node lifecycle on one machine: every `request` starts
+    actual raylet SUBPROCESSES via the Cluster fixture (not in-process
+    fakes), so autoscaler e2e tests exercise registration, heartbeats and
+    draining with zero cloud calls. Slice shapes come up as N labelled
+    hosts or not at all."""
+
+    def __init__(self, cluster, num_cpus_per_node: float = 2.0, delay_s: float = 0.0):
+        self._cluster = cluster
+        self._num_cpus = num_cpus_per_node
+        self._delay_s = delay_s
+        self._lock = threading.Lock()
+        self._seq = 0
+        # cloud_id -> {"status", "nodes": [node_id...], "labels": {...}}
+        self._instances: Dict[str, dict] = {}
+
+    def request(self, instance) -> str:
+        with self._lock:
+            self._seq += 1
+            cloud_id = f"local-{self._seq}"
+            self._instances[cloud_id] = {"status": "pending", "nodes": [], "labels": {}}
+        threading.Thread(
+            target=self._allocate,
+            args=(cloud_id, dict(instance.shape)),
+            daemon=True,
+        ).start()
+        return cloud_id
+
+    def _allocate(self, cloud_id: str, shape: Dict[str, Any]) -> None:
+        import time
+
+        if self._delay_s:
+            time.sleep(self._delay_s)
+        hosts = max(1, int(shape.get("slice_hosts", 1)))
+        res = {"CPU": float(shape.get("cpus", self._num_cpus))}
+        tpus = float(shape.get("tpus", 0.0))
+        if tpus:
+            res["TPU"] = tpus
+        labels = {"ray_tpu_cloud_id": cloud_id}
+        if hosts > 1:
+            labels["slice_name"] = cloud_id
+        nodes: List[str] = []
+        try:
+            for i in range(hosts):
+                node_labels = dict(labels)
+                if hosts > 1:
+                    node_labels["worker_index"] = i
+                nodes.append(
+                    self._cluster.add_node(resources=dict(res), labels=node_labels)
+                )
+        except Exception:
+            # Atomicity: a partial slice is torn down, never reported up.
+            for nid in nodes:
+                try:
+                    self._cluster.remove_node(nid)
+                except Exception:
+                    pass
+            with self._lock:
+                rec = self._instances.get(cloud_id)
+                if rec is not None:
+                    rec["status"] = "failed"
+            return
+        with self._lock:
+            rec = self._instances.get(cloud_id)
+            if rec is None:
+                # Terminated while allocating: nobody wants these nodes.
+                for nid in nodes:
+                    try:
+                        self._cluster.remove_node(nid)
+                    except Exception:
+                        pass
+                return
+            rec["nodes"] = nodes
+            rec["labels"] = labels
+            rec["status"] = "running"
+
+    def poll(self) -> Dict[str, str]:
+        with self._lock:
+            return {cid: rec["status"] for cid, rec in self._instances.items()}
+
+    def ray_node_for(self, cloud_id: str) -> Optional[str]:
+        with self._lock:
+            rec = self._instances.get(cloud_id)
+            if rec is None or rec["status"] != "running" or not rec["nodes"]:
+                return None
+            return rec["nodes"][0]
+
+    def node_labels(self, cloud_id: str) -> Dict[str, str]:
+        with self._lock:
+            rec = self._instances.get(cloud_id)
+            return dict(rec["labels"]) if rec else {}
+
+    def terminate(self, cloud_id: str) -> None:
+        with self._lock:
+            rec = self._instances.pop(cloud_id, None)
+        for nid in (rec or {}).get("nodes", ()):
+            try:
+                self._cluster.remove_node(nid)
+            except Exception:
+                pass
+
+
+class GceTpuNodeProvider(NodeProvider):
+    """Cloud TPU-VM provider over the v2 REST API (reference:
+    _private/gcp/node.py GCPTPUNode — googleapiclient there; a bare
+    injectable transport here so tests stub the wire). One REST node
+    resource IS the whole pod slice, so multi-host creation is atomic at
+    the API; this provider adds the other half of the contract: a READY
+    node missing worker endpoints, or one that lands in ERROR, is deleted
+    (terminate-on-partial-failure) and reported "failed" so the
+    reconciler's retry/backoff machinery replaces it."""
+
+    # TPU API node states -> reconciler vocabulary.
+    _STATE_MAP = {
+        "READY": "running",
+        "CREATING": "pending",
+        "STARTING": "pending",
+        "RESTARTING": "pending",
+        "REPAIRING": "pending",
+        "STOPPING": "pending",
+        "STOPPED": "failed",
+        "ERROR": "failed",
+        "TERMINATED": "failed",
+        "PREEMPTED": "failed",
+    }
+
+    def __init__(
+        self,
+        project: str,
+        zone: str,
+        *,
+        accelerator_type: str = "v5litepod-8",
+        runtime_version: str = "tpu-ubuntu2204-base",
+        cluster_name: str = "ray-tpu",
+        head_address: Optional[str] = None,
+        startup_script: str = "",
+        transport: Optional[HttpTransport] = None,
+        gcs=None,
+        request_timeout_s: float = 30.0,
+    ):
+        self.project, self.zone = project, zone
+        self.accelerator_type = accelerator_type
+        self.runtime_version = runtime_version
+        self.cluster_name = cluster_name
+        self.head_address = head_address
+        self.startup_script = startup_script
+        self._transport = transport or HttpTransport()
+        self._gcs = gcs
+        self._timeout = request_timeout_s
+        self._lock = threading.Lock()
+        # cloud_id -> {"hosts": expected host count, "labels": {...}}
+        self._created: Dict[str, dict] = {}
+        self._token: Optional[str] = None
+        self._token_expiry = 0.0
+
+    # ------------------------------------------------------------- plumbing
+    def _base(self) -> str:
+        return f"{TPU_REST_URL}/projects/{self.project}/locations/{self.zone}/nodes"
+
+    def _headers(self) -> Dict[str, str]:
+        import time
+
+        # Metadata-server tokens live ~1 h; refetching per REST call would
+        # double the request volume of every reconcile round.
+        if self._token is None or time.monotonic() >= self._token_expiry:
+            self._token = gce_access_token(self._transport)
+            self._token_expiry = time.monotonic() + 45 * 60
+        return {"Authorization": f"Bearer {self._token}"} if self._token else {}
+
+    def _call(self, method: str, url: str, body: Optional[dict] = None) -> dict:
+        status, text = self._transport.request(
+            method, url, body=body, headers=self._headers(), timeout=self._timeout
+        )
+        if not 200 <= status < 300:
+            raise RuntimeError(
+                f"TPU API {method} {url.split('/nodes')[-1] or '/nodes'} "
+                f"failed: HTTP {status} {text[:300]}"
+            )
+        try:
+            return json.loads(text) if text else {}
+        except ValueError:
+            return {}
+
+    def _startup_script(self, cloud_id: str) -> str:
+        """The boot script joining every slice host to the cluster with the
+        cloud-id label — how ray_node_for later matches machine to node."""
+        lines = ["#!/bin/bash"]
+        if self.head_address:
+            labels = json.dumps({"ray_tpu_cloud_id": cloud_id})
+            lines.append(
+                f"python -m ray_tpu.scripts start --address {self.head_address} "
+                f"--labels '{labels}'"
+            )
+        if self.startup_script:
+            lines.append(self.startup_script)
+        return "\n".join(lines)
+
+    # -------------------------------------------------------------- provider
+    def request(self, instance) -> str:
+        shape = dict(getattr(instance, "shape", None) or {})
+        accel_type = shape.get("accelerator_type", self.accelerator_type)
+        parsed = parse_pod_type(accel_type)
+        hosts = parsed[3] if parsed else 1
+        want_hosts = int(shape.get("slice_hosts", 0))
+        if want_hosts and want_hosts != hosts:
+            # On Cloud TPU the pod type IS the geometry; a shape asking for
+            # different host counts would be silently dropped otherwise.
+            raise ValueError(
+                f"shape requests slice_hosts={want_hosts} but accelerator "
+                f"type {accel_type!r} is a {hosts}-host slice"
+            )
+        cloud_id = f"raytpu-{instance.instance_id[:12]}"
+        labels = {
+            "ray-tpu-cluster": self.cluster_name,
+            "ray-tpu-instance": instance.instance_id[:24],
+        }
+        body = {
+            "acceleratorType": accel_type,
+            "runtimeVersion": shape.get("runtime_version", self.runtime_version),
+            "labels": labels,
+            "metadata": {"startup-script": self._startup_script(cloud_id)},
+        }
+        self._call("POST", f"{self._base()}?nodeId={cloud_id}", body)
+        with self._lock:
+            self._created[cloud_id] = {"hosts": hosts, "labels": labels}
+        return cloud_id
+
+    def _list_nodes(self) -> Dict[str, dict]:
+        """All nodes in the zone, following nextPageToken — an unrelated
+        node pushing ours to page 2 must not read as "gone" (reconcile
+        would terminate a healthy slice over it)."""
+        by_name: Dict[str, dict] = {}
+        token = ""
+        while True:
+            url = self._base() + (f"?pageToken={token}" if token else "")
+            listing = self._call("GET", url)
+            for node in listing.get("nodes", []):
+                by_name[node.get("name", "").rsplit("/", 1)[-1]] = node
+            token = listing.get("nextPageToken", "")
+            if not token:
+                return by_name
+
+    def poll(self) -> Dict[str, str]:
+        with self._lock:
+            created = dict(self._created)
+        if not created:
+            return {}
+        by_name = self._list_nodes()
+        out: Dict[str, str] = {}
+        for cloud_id, rec in created.items():
+            node = by_name.get(cloud_id)
+            if node is None:
+                out[cloud_id] = "gone"
+                continue
+            state = self._STATE_MAP.get(node.get("state", ""), "pending")
+            if state == "running":
+                endpoints = node.get("networkEndpoints") or []
+                if len(endpoints) < rec["hosts"]:
+                    # READY but hosts are missing: a partial slice cannot
+                    # serve a gang — delete it and let the reconciler retry.
+                    self._safe_delete(cloud_id)
+                    state = "failed"
+            elif state == "failed":
+                self._safe_delete(cloud_id)
+            out[cloud_id] = state
+        return out
+
+    def _safe_delete(self, cloud_id: str) -> None:
+        try:
+            self._call("DELETE", f"{self._base()}/{cloud_id}")
+        except Exception:
+            pass  # already gone / API hiccup: poll reports it next round
+
+    def ray_node_for(self, cloud_id: str) -> Optional[str]:
+        if self._gcs is None:
+            return None
+        with self._lock:
+            rec = self._created.get(cloud_id)
+        hosts = rec["hosts"] if rec else 1
+        try:
+            nodes = self._gcs.call("list_nodes")
+        except Exception:
+            return None
+        joined = [
+            n
+            for n in nodes
+            if n.get("Alive")
+            and (n.get("Labels") or {}).get("ray_tpu_cloud_id") == cloud_id
+        ]
+        if len(joined) < hosts:
+            return None  # slice joins atomically: all hosts or not yet
+        joined.sort(key=lambda n: int((n.get("Labels") or {}).get("worker_index", 0)))
+        return joined[0]["NodeID"]
+
+    def node_labels(self, cloud_id: str) -> Dict[str, str]:
+        with self._lock:
+            rec = self._created.get(cloud_id)
+            return dict(rec["labels"]) if rec else {}
+
+    def terminate(self, cloud_id: str) -> None:
+        try:
+            self._call("DELETE", f"{self._base()}/{cloud_id}")
+        except RuntimeError as e:
+            # Already gone (preempted, deleted out-of-band, or torn down by
+            # a poll round): termination's goal is achieved — raising here
+            # would wedge the instance in TERMINATING, retrying a DELETE
+            # that can never succeed.
+            if "HTTP 404" not in str(e):
+                raise
+        finally:
+            with self._lock:
+                self._created.pop(cloud_id, None)

@@ -56,7 +56,14 @@ class Instance:
 class CloudProvider:
     """Async provider ABC (reference: node_provider.py:13, made honest
     about asynchrony): request() returns immediately; poll() reports the
-    cloud's view; the reconciler converges the difference."""
+    cloud's view; the reconciler converges the difference.
+
+    The accelerators subsystem implements this contract as
+    :class:`ray_tpu.accelerators.NodeProvider` with two production-shaped
+    providers — `LocalNodeProvider` (real raylet subprocesses, the e2e
+    test provider) and `GceTpuNodeProvider` (Cloud TPU REST slices) —
+    re-exported at the bottom of this module; InstanceManager drives any
+    of them interchangeably."""
 
     def request(self, instance: Instance) -> str:
         """Begins allocation; returns the provider's cloud_id."""
@@ -76,10 +83,12 @@ class CloudProvider:
 
 
 class GCETPUProvider(CloudProvider):
-    """GCE TPU-VM provider shelling out to `gcloud compute tpus tpu-vm`
-    (reference: _private/gcp/node_provider.py; TPU pod slices allocate
-    atomically — one create call per slice). Requires gcloud on PATH and
-    an authenticated project; every call degrades with a clear error."""
+    """LEGACY GCE TPU-VM provider shelling out to `gcloud compute tpus
+    tpu-vm` (reference: _private/gcp/node_provider.py). Superseded by
+    accelerators.GceTpuNodeProvider (REST through an injectable transport,
+    slice-atomicity checks, label propagation); kept for environments
+    where only the gcloud CLI is authenticated. Requires gcloud on PATH;
+    every call degrades with a clear error."""
 
     def __init__(self, zone: str, project: str, accelerator_type: str = "v5litepod-8",
                  version: str = "tpu-ubuntu2204-base", startup_script: str = ""):
@@ -373,3 +382,26 @@ class InstanceManager:
                 return True
             time.sleep(interval)
         return False
+
+    def wait_allocated(self, n: int, timeout: float = 600.0, interval: float = 0.5) -> bool:
+        """Converge until `n` instances are at least cloud-allocated
+        (ALLOCATED or RAY_RUNNING). The `ray-tpu up` launcher waits on
+        this when it has no GCS to observe ray joins through (the head
+        may be one of the machines being created)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.reconcile()
+            c = self.counts()
+            if c.get(ALLOCATED, 0) + c.get(RAY_RUNNING, 0) >= n:
+                return True
+            time.sleep(interval)
+        return False
+
+
+# Provider implementations living with the accelerator subsystem (one
+# import surface for reconciler + providers; see module docstring).
+from .accelerators.node_provider import (  # noqa: E402  (re-export)
+    GceTpuNodeProvider,
+    LocalNodeProvider,
+    NodeProvider,
+)

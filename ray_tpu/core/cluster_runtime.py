@@ -1279,6 +1279,11 @@ class ClusterRuntime(Runtime):
             pass
         if self._driver and self._procs:
             for node in self.nodes():
+                if not node.get("Alive"):
+                    # Drained/terminated nodes have no raylet behind their
+                    # socket; dialing them burns the full 20 s connect
+                    # timeout each (40 s teardowns in autoscaler e2e).
+                    continue
                 try:
                     self._raylet_for(node["sock"]).call("stop", timeout=2.0)
                 except Exception:
@@ -1402,6 +1407,7 @@ class Cluster:
         num_workers: Optional[int] = None,
         head_port: Optional[int] = None,
         node_ip: str = "127.0.0.1",
+        labels: Optional[Dict[str, Any]] = None,
     ):
         """head_port enables multi-host mode: the GCS additionally listens
         on tcp://node_ip:head_port (0 = ephemeral) and every raylet serves
@@ -1444,7 +1450,18 @@ class Cluster:
         head_res.setdefault("CPU", float(num_cpus if num_cpus is not None else os.cpu_count() or 1))
         if num_tpus:
             head_res.setdefault("TPU", float(num_tpus))
-        self.head_node_id = self.add_node(resources=head_res, num_workers=num_workers)
+        elif num_tpus is None and "TPU" not in head_res:
+            # Autodetect through the accelerator registry (env/devdir/
+            # metadata chain) so a head started on a real TPU VM registers
+            # its chips without flags (reference: ray_params resolving
+            # resources via the accelerator managers at node start).
+            from ..accelerators import detect_accelerators
+
+            for k, v in detect_accelerators().items():
+                head_res.setdefault(k, v)
+        self.head_node_id = self.add_node(
+            resources=head_res, num_workers=num_workers, labels=labels
+        )
         info = {
             "gcs_sock": self.gcs_sock,
             "gcs_tcp_address": self.gcs_tcp_address,
@@ -1615,6 +1632,14 @@ def start_worker_node(
     res.setdefault("CPU", float(os.cpu_count() or 1))
     if num_tpus:
         res.setdefault("TPU", float(num_tpus))
+    elif num_tpus is None and "TPU" not in res:
+        # Same registry-backed autodetection as the head: a TPU-VM worker
+        # joining with `ray-tpu start --address` advertises its chips (and
+        # the raylet fills in slice labels from detection).
+        from ..accelerators import detect_accelerators
+
+        for k, v in detect_accelerators().items():
+            res.setdefault(k, v)
     capacity = int(object_store_memory or CONFIG.object_store_memory)
     store = _pick_store_path(session_dir, node_id, capacity)
     sock = os.path.join(session_dir, f"raylet_{node_id}.sock")

@@ -82,10 +82,47 @@ class RayletService:
         self.total = dict(resources)
         self.available = dict(resources)
         self.labels = dict(labels or {})
-        # Physical chip indices not leased to any bundle (TPU env isolation:
-        # bundle-pinned workers see only their chips via TPU_VISIBLE_CHIPS,
-        # reference: _private/accelerators/tpu.py set_accelerator_visible).
-        self._free_chips: Set[int] = set(range(int(resources.get("TPU", 0))))
+        # Accelerator accounting goes through the manager registry
+        # (ray_tpu.accelerators; reference: _private/accelerators/
+        # accelerator.py — node startup consults the family manager, the
+        # raylet no longer hardcodes TPU semantics). The manager supplies:
+        # which physical chip indices this raylet may lease to bundles
+        # (respecting an inherited TPU_VISIBLE_CHIPS restriction), the
+        # spawn-time visibility env for workers, and — when the node
+        # carries chips but no slice identity — the pod-slice labels
+        # detected from env/metadata, so SLICE_GANG placement sees real
+        # slices exactly like the test fixtures' fake ones.
+        from ..accelerators import get_accelerator_manager
+
+        self._tpu_manager = get_accelerator_manager("TPU")
+        n_chips = int(resources.get("TPU", 0))
+        if self._tpu_manager is not None:
+            self._free_chips: Set[int] = set(
+                self._tpu_manager.visible_chip_ids(n_chips)
+            )
+        else:
+            self._free_chips = set(range(n_chips))
+        if n_chips and len(self._free_chips) < n_chips:
+            # An inherited TPU_VISIBLE_CHIPS restriction leaves fewer
+            # leasable chips than the declared count. Clamp the schedulable
+            # total to match: otherwise a bundle could reserve more TPU
+            # than this raylet has chips for, skip the chip lease, and its
+            # workers would see every chip — including ones owned by
+            # sibling raylets (the exact sharing the lease table prevents).
+            self.total["TPU"] = self.available["TPU"] = float(
+                len(self._free_chips)
+            )
+        if n_chips and "slice_name" not in self.labels and self._tpu_manager is not None:
+            try:
+                spec = self._tpu_manager.detect_slice_spec()
+            except Exception:
+                spec = None
+            if spec is not None and spec.slice_name:
+                self.labels.setdefault("slice_name", spec.slice_name)
+                self.labels.setdefault("worker_index", spec.worker_index)
+                self.labels.setdefault("tpu_version", spec.version)
+                if spec.topology:
+                    self.labels.setdefault("tpu_topology", spec.topology)
         self._res_lock = threading.Lock()
         # Placement-group bundle reservations hosted on this node:
         # (pg_id, bundle_index) -> {"reserved": {...}, "free": {...}}.
@@ -193,7 +230,10 @@ class RayletService:
             threading.Thread(target=self._flush_loop, daemon=True, name="flush"),
         ]
         reg = self.gcs.call(
-            "register_node", node_id, self.advertised, store_path, resources, self.labels
+            # self.total, not the raw arg: the visible-chip clamp above must
+            # be what the cluster schedules against (heartbeat re-register
+            # already advertises self.total).
+            "register_node", node_id, self.advertised, store_path, self.total, self.labels
         )
         self._cluster_size = reg.get("nodes", 1) if isinstance(reg, dict) else 1
         # Internal metrics: this raylet's hot-path instruments flush
@@ -1811,14 +1851,17 @@ class RayletService:
                 env[str(k)] = str(v)
             env["RAY_TPU_RUNTIME_ENV"] = json.dumps(renv)
         tpu = desc.get("tpu")
-        if tpu:
-            # Chip isolation for co-located gangs (reference:
-            # _private/accelerators/tpu.py TPU_VISIBLE_CHIPS / worker env).
-            env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in tpu["chips"])
-            env["TPU_CHIPS_PER_HOST_BOUNDS"] = f"1,1,{len(tpu['chips'])}"
-            if tpu.get("slice"):
-                env["TPU_SLICE_NAME"] = str(tpu["slice"])
-            env["TPU_WORKER_ID"] = str(tpu.get("worker_index", 0))
+        if tpu and self._tpu_manager is not None:
+            # Chip isolation for co-located gangs: the accelerator manager
+            # owns the env-var protocol (reference:
+            # _private/accelerators/tpu.py set_accelerator_visible).
+            env.update(
+                self._tpu_manager.worker_visibility_env(
+                    tpu["chips"],
+                    slice_name=tpu.get("slice"),
+                    worker_index=tpu.get("worker_index", 0),
+                )
+            )
         # Worker stdout/stderr land in per-process session log files
         # (reference: worker-<id>-out/err under the session's logs dir) —
         # a user print inside a task must be recoverable.

@@ -161,20 +161,25 @@ def detect_node_resources(
     num_tpus: Optional[float] = None,
     object_store_memory: Optional[int] = None,
 ) -> Dict[str, float]:
-    """Autodetects this host's resources (CPUs via os, TPU chips via jax if
-    importable without initializing a backend; falls back to /dev/accel*,
-    the same probe the reference uses at python/ray/_private/accelerators/tpu.py:98)."""
-    import os
+    """Autodetects this host's resources through the accelerator registry
+    (ray_tpu.accelerators): CPUs from the CPU manager, TPU chips from the
+    TpuAcceleratorManager's env/devdir/metadata chain, and any plugin
+    family the registry carries. Explicit num_cpus/num_tpus override
+    detection for their resource."""
+    from .. import accelerators
 
     res: Dict[str, float] = {}
-    res[CPU] = float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))
+    if num_cpus is not None:
+        res[CPU] = float(num_cpus)
+    else:
+        cpu_mgr = accelerators.get_accelerator_manager(CPU)
+        res[CPU] = float(cpu_mgr.get_current_node_num_accelerators() if cpu_mgr else 1)
+    detected = accelerators.detect_accelerators()
     if num_tpus is not None:
+        detected.pop(TPU, None)
         if num_tpus:
             res[TPU] = float(num_tpus)
-    else:
-        n_accel = len([d for d in os.listdir("/dev") if d.startswith("accel")]) if os.path.isdir("/dev") else 0
-        if n_accel:
-            res[TPU] = float(n_accel)
+    res.update(detected)
     if object_store_memory:
         res[OBJECT_STORE_MEMORY] = float(object_store_memory)
     return res

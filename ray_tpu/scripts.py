@@ -25,6 +25,20 @@ def _record_session(session_dir: str) -> None:
         f.write(session_dir)
 
 
+def _detach_cluster(cluster) -> None:
+    """Detaches a Cluster's daemons from this CLI process so they outlive
+    it (reference: `ray start` leaving raylets running): drop the
+    kill-children atexit hook, record every daemon pid for `stop`, and
+    point the latest-session file here."""
+    import atexit
+
+    atexit.unregister(cluster._cleanup)
+    pids = [p.pid for p in cluster._procs]
+    with open(os.path.join(cluster.session_dir, "pids.json"), "w") as f:
+        json.dump(pids, f)
+    _record_session(cluster.session_dir)
+
+
 def _resolve_address(args) -> str:
     if getattr(args, "address", None):
         return args.address
@@ -36,11 +50,10 @@ def _resolve_address(args) -> str:
 
 
 def cmd_start(args) -> None:
-    import atexit
-
     from .core.cluster_runtime import Cluster, start_worker_node
 
     resources = json.loads(args.resources) if args.resources else None
+    labels = json.loads(args.labels) if getattr(args, "labels", None) else None
     if args.address:
         # Worker-node mode (reference: `ray start --address=head:port`).
         info = start_worker_node(
@@ -50,6 +63,7 @@ def cmd_start(args) -> None:
             num_tpus=args.num_tpus,
             resources=resources,
             object_store_memory=args.object_store_memory,
+            labels=labels,
         )
         with open(os.path.join(info["session_dir"], "pids.json"), "w") as f:
             json.dump([info["proc"].pid], f)
@@ -86,14 +100,9 @@ def cmd_start(args) -> None:
         object_store_memory=args.object_store_memory,
         head_port=args.port,
         node_ip=node_ip,
+        labels=labels,
     )
-    # The daemons must outlive this CLI process (reference: `ray start`
-    # leaves raylets running): drop the kill-children atexit hook.
-    atexit.unregister(cluster._cleanup)
-    pids = [p.pid for p in cluster._procs]
-    with open(os.path.join(cluster.session_dir, "pids.json"), "w") as f:
-        json.dump(pids, f)
-    _record_session(cluster.session_dir)
+    _detach_cluster(cluster)
     print(f"started cluster; session dir: {cluster.session_dir}")
     print(f"connect with: ray_tpu.init(address={cluster.session_dir!r})")
     if cluster.gcs_tcp_address:
@@ -157,9 +166,21 @@ def cmd_status(args) -> None:
     print(f"nodes alive: {stats['nodes_alive']}")
     for n in state.list_nodes():
         mark = "up" if n["Alive"] else "DOWN"
+        labels = n.get("Labels") or {}
+        slice_info = ""
+        if labels.get("slice_name"):
+            # Accelerator autodetection (or the provider) stamped slice
+            # identity: show where each host sits in its pod slice.
+            slice_info = (
+                f" slice={labels['slice_name']}"
+                f"[{labels.get('worker_index', 0)}]"
+            )
+            if labels.get("tpu_topology"):
+                slice_info += f" topology={labels['tpu_topology']}"
         print(
             f"  [{mark}] {n['NodeID'][:12]} resources={n['Resources']} "
             f"available={n['Available']} workers={n['Stats'].get('num_workers', 0)}"
+            f"{slice_info}"
         )
     print(f"tasks: {stats['tasks']}")
     print(f"actors: {stats['actors']}")
@@ -168,6 +189,245 @@ def cmd_status(args) -> None:
         f"object store: {s['num_objects']} objects, "
         f"{s['bytes_in_use'] / (1 << 20):.1f} MiB in use, {s['num_spilled']} spilled"
     )
+
+
+_CLUSTER_STATE_DIR = os.path.expanduser("~/.ray_tpu/clusters")
+
+
+def _load_cluster_config(path: str) -> dict:
+    """Cluster-config YAML (reference: the `ray up` cluster YAML,
+    autoscaler/ray-schema.json — collapsed to the fields the TPU launcher
+    needs). JSON is valid YAML, so a .json config works too."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        import yaml
+
+        cfg = yaml.safe_load(text)
+    except ImportError:
+        cfg = json.loads(text)
+    if not isinstance(cfg, dict):
+        raise SystemExit(f"{path}: cluster config must be a mapping")
+    cfg.setdefault("cluster_name", "ray-tpu")
+    provider = cfg.setdefault("provider", {})
+    ptype = provider.setdefault("type", "local")
+    if ptype not in ("local", "gce_tpu"):
+        raise SystemExit(f"{path}: provider.type must be 'local' or 'gce_tpu'")
+    if ptype == "gce_tpu":
+        for key in ("project_id", "zone"):
+            if not provider.get(key):
+                raise SystemExit(f"{path}: provider.{key} is required for gce_tpu")
+        if not (cfg.get("workers") or {}).get("accelerator_type"):
+            # The pod type IS the slice geometry on Cloud TPU; silently
+            # substituting a default would provision the wrong hardware.
+            raise SystemExit(
+                f"{path}: workers.accelerator_type is required for gce_tpu "
+                "(e.g. v5litepod-16)"
+            )
+    cfg.setdefault("head", {})
+    workers = cfg.setdefault("workers", {})
+    workers.setdefault("count", 1)
+    return cfg
+
+
+def _cluster_state_path(name: str) -> str:
+    return os.path.join(_CLUSTER_STATE_DIR, f"{name}.json")
+
+
+def _worker_shape(cfg: dict) -> dict:
+    w = cfg["workers"]
+    shape = {
+        "cpus": float(w.get("cpus", 2.0)),
+        "tpus": float(w.get("tpus", 0.0)),
+        "slice_hosts": int(w.get("slice_hosts", 1)),
+    }
+    if w.get("accelerator_type"):
+        shape["accelerator_type"] = w["accelerator_type"]
+        # Declared pod type implies the slice geometry; fill what the
+        # config leaves implicit so providers and status agree.
+        from .accelerators import parse_pod_type
+
+        parsed = parse_pod_type(w["accelerator_type"])
+        if parsed is not None:
+            _version, _total, chips_per_host, hosts = parsed
+            if "tpus" not in w:
+                shape["tpus"] = float(chips_per_host)
+            if "slice_hosts" not in w:
+                shape["slice_hosts"] = hosts
+    if w.get("runtime_version"):
+        shape["runtime_version"] = w["runtime_version"]
+    return shape
+
+
+def cmd_up(args) -> None:
+    """`ray-tpu up cluster.yaml`: brings a cluster to the configured size
+    through the autoscaler-v2 reconciler (reference: `ray up` driving the
+    v2 instance manager). provider.type=local starts real raylet
+    subprocesses on this machine; gce_tpu creates TPU pod slices over the
+    Cloud TPU REST API — atomically, one slice per worker entry."""
+    from .autoscaler_v2 import InstanceManager
+
+    cfg = _load_cluster_config(args.config)
+    name = cfg["cluster_name"]
+    provider_cfg = cfg["provider"]
+    shape = _worker_shape(cfg)
+    count = int(cfg["workers"]["count"])
+    os.makedirs(_CLUSTER_STATE_DIR, exist_ok=True)
+    state_path = _cluster_state_path(name)
+    if os.path.exists(state_path) and not args.force:
+        raise SystemExit(
+            f"cluster {name!r} already has recorded state ({state_path}); "
+            "run `ray-tpu down` first or pass --force"
+        )
+
+    if provider_cfg["type"] == "local":
+        from .accelerators import LocalNodeProvider
+        from .core.cluster_runtime import Cluster
+        from .core.rpc import RpcClient
+
+        head = cfg["head"]
+        cluster = Cluster(
+            num_cpus=head.get("num_cpus"),
+            num_tpus=head.get("num_tpus"),
+            head_port=head.get("port"),
+            labels=head.get("labels"),
+        )
+        provider = LocalNodeProvider(cluster)
+        im = InstanceManager(
+            provider, gcs=RpcClient(cluster.gcs_sock), shape=shape
+        )
+        im.set_target(count)
+        ok = im.wait_running(count, timeout=args.timeout)
+        # Let in-flight allocations land before snapshotting: a raylet
+        # spawned by a provider thread AFTER pids.json is written would
+        # escape both the pid record and `ray-tpu down`.
+        quiesce = time.monotonic() + 15.0
+        while (
+            any(s == "pending" for s in provider.poll().values())
+            and time.monotonic() < quiesce
+        ):
+            time.sleep(0.2)
+        # Detach AFTER the wait so pids.json captures every raylet the
+        # provider spawned while scaling up.
+        _detach_cluster(cluster)
+        state = {
+            "type": "local",
+            "cluster_name": name,
+            "session_dir": cluster.session_dir,
+            "cloud_ids": [
+                i.cloud_id for i in im.instances.values() if i.cloud_id
+            ],
+        }
+        with open(state_path, "w") as f:
+            json.dump(state, f)
+        running = im.counts().get("RAY_RUNNING", 0)
+        print(
+            f"cluster {name!r} up: head + {running}/{count} worker instances "
+            f"(session dir: {cluster.session_dir})"
+        )
+        print(f"connect with: ray_tpu.init(address={cluster.session_dir!r})")
+        if not ok:
+            raise SystemExit(1)
+        return
+
+    provider = _gce_provider(cfg)
+    im = InstanceManager(
+        provider,
+        shape=shape,
+        # Cloud TPU slice allocation is minutes-long; the reconciler must
+        # not time a REQUESTED slice out under it.
+        request_timeout_s=max(600.0, args.timeout),
+    )
+    im.set_target(count)
+
+    def record_state() -> list:
+        cloud_ids = [i.cloud_id for i in im.instances.values() if i.cloud_id]
+        with open(state_path, "w") as f:
+            json.dump(
+                {
+                    "type": "gce_tpu",
+                    "cluster_name": name,
+                    "project_id": provider_cfg["project_id"],
+                    "zone": provider_cfg["zone"],
+                    "cloud_ids": cloud_ids,
+                },
+                f,
+            )
+        return cloud_ids
+
+    # Issue the create calls, then record state BEFORE the (minutes-long)
+    # allocation wait: a Ctrl-C mid-wait must leave `ray-tpu down` a
+    # record of every slice already billing.
+    im.reconcile()
+    record_state()
+    try:
+        # Slice allocation is minutes-long; a gentle poll interval keeps
+        # the Cloud TPU LIST quota (order 100 reads/min) untouched.
+        ok = im.wait_allocated(count, timeout=args.timeout, interval=5.0)
+    finally:
+        cloud_ids = record_state()
+    c = im.counts()
+    print(
+        f"cluster {name!r}: {c.get('ALLOCATED', 0) + c.get('RAY_RUNNING', 0)}"
+        f"/{count} slices allocated ({', '.join(cloud_ids) or 'none'})"
+    )
+    if not ok:
+        print("warning: not all slices came up before the timeout", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _gce_provider(cfg: dict):
+    from .accelerators import GceTpuNodeProvider
+
+    provider_cfg = cfg["provider"]
+    workers = cfg["workers"]
+    return GceTpuNodeProvider(
+        provider_cfg["project_id"],
+        provider_cfg["zone"],
+        accelerator_type=workers.get("accelerator_type", "v5litepod-8"),
+        runtime_version=workers.get("runtime_version", "tpu-ubuntu2204-base"),
+        cluster_name=cfg["cluster_name"],
+        head_address=provider_cfg.get("head_address"),
+        startup_script=cfg.get("setup_script", ""),
+    )
+
+
+def cmd_down(args) -> None:
+    """`ray-tpu down cluster.yaml`: terminates everything `up` recorded."""
+    cfg = _load_cluster_config(args.config)
+    name = cfg["cluster_name"]
+    state_path = _cluster_state_path(name)
+    try:
+        with open(state_path) as f:
+            state = json.load(f)
+    except OSError:
+        raise SystemExit(f"no recorded state for cluster {name!r} ({state_path})")
+    if state["type"] == "local":
+        ns = argparse.Namespace(address=state["session_dir"])
+        try:
+            cmd_stop(ns)
+        except SystemExit:
+            pass
+    else:
+        # Teardown targets what the STATE recorded, not what the YAML says
+        # now: an edited project/zone would make every DELETE a 404
+        # (treated as already-gone) and silently leak billing slices.
+        from .accelerators import GceTpuNodeProvider
+
+        provider = GceTpuNodeProvider(
+            state["project_id"], state["zone"], cluster_name=name
+        )
+        for cloud_id in state.get("cloud_ids", []):
+            try:
+                provider.terminate(cloud_id)
+                print(f"deleted {cloud_id}")
+            except Exception as e:
+                print(f"warning: failed to delete {cloud_id}: {e}", file=sys.stderr)
+    try:
+        os.unlink(state_path)
+    except OSError:
+        pass
+    print(f"cluster {name!r} down")
 
 
 def cmd_submit(args) -> None:
@@ -304,7 +564,27 @@ def main(argv=None) -> None:
         default=None,
         help="join an existing cluster: the head's tcp://host:port GCS endpoint",
     )
+    p.add_argument(
+        "--labels",
+        default=None,
+        help="JSON dict of node labels (e.g. slice identity or the "
+        "provider's cloud-id stamp)",
+    )
     p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser(
+        "up", help="bring a cluster to its configured size from a cluster-config YAML"
+    )
+    p.add_argument("config", help="cluster-config YAML (or JSON) path")
+    p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument(
+        "--force", action="store_true", help="ignore existing recorded state"
+    )
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="terminate a cluster started with `ray-tpu up`")
+    p.add_argument("config", help="the same cluster-config YAML given to `up`")
+    p.set_defaults(fn=cmd_down)
 
     p = sub.add_parser("stop", help="stop the cluster")
     p.add_argument("--address", default=None)

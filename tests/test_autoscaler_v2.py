@@ -15,6 +15,7 @@ from ray_tpu.autoscaler_v2 import (
     FakeCloudProvider,
     Instance,
     InstanceManager,
+    LocalNodeProvider,
 )
 
 
@@ -150,6 +151,86 @@ def test_fake_cloud_end_to_end_nodes_join():
             im.reconcile()
             alive = [n for n in rt._gcs.call("list_nodes") if n["Alive"]]
             if len(alive) == 1:
+                break
+            time.sleep(0.2)
+        assert len([n for n in rt._gcs.call("list_nodes") if n["Alive"]]) == 1
+    finally:
+        rt.shutdown()
+        cluster.shutdown()
+
+
+def test_local_node_provider_end_to_end_scale_up_down():
+    """Satellite acceptance: the reconciler scales a cluster up by 2 REAL
+    raylet subprocesses through accelerators.LocalNodeProvider — nodes
+    register, heartbeat, carry the provider's cloud-id label — and back
+    down to zero, with no cloud calls anywhere."""
+    import ray_tpu as rtpu
+    from ray_tpu.core import runtime_base
+    from ray_tpu.core.cluster_runtime import Cluster
+
+    rtpu.shutdown()
+    cluster = Cluster(num_cpus=1, num_workers=0)
+    rt = cluster.runtime()
+    runtime_base.set_runtime(rt)
+    try:
+        provider = LocalNodeProvider(cluster, num_cpus_per_node=1.0)
+        im = InstanceManager(provider, gcs=rt._gcs, shape={"cpus": 1.0})
+        im.set_target(2)
+        assert im.wait_running(2, timeout=60.0), im.counts()
+        alive = [n for n in rt._gcs.call("list_nodes") if n["Alive"]]
+        assert len(alive) == 3  # head + 2 provisioned raylet subprocesses
+        labelled = [
+            n for n in alive if (n.get("Labels") or {}).get("ray_tpu_cloud_id")
+        ]
+        assert len(labelled) == 2  # provider label propagated to the nodes
+        # Scale back down: provisioned nodes terminate and leave the GCS.
+        im.set_target(0)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            im.reconcile()
+            if len([n for n in rt._gcs.call("list_nodes") if n["Alive"]]) == 1:
+                break
+            time.sleep(0.2)
+        assert len([n for n in rt._gcs.call("list_nodes") if n["Alive"]]) == 1
+    finally:
+        rt.shutdown()
+        cluster.shutdown()
+
+
+def test_local_node_provider_slice_atomicity():
+    """A slice-shaped request comes up as N labelled hosts sharing one
+    slice_name (what SLICE_GANG placement keys on), and terminates as one
+    unit."""
+    import ray_tpu as rtpu
+    from ray_tpu.core import runtime_base
+    from ray_tpu.core.cluster_runtime import Cluster
+
+    rtpu.shutdown()
+    cluster = Cluster(num_cpus=1, num_workers=0)
+    rt = cluster.runtime()
+    runtime_base.set_runtime(rt)
+    try:
+        provider = LocalNodeProvider(cluster)
+        im = InstanceManager(
+            provider, gcs=rt._gcs, shape={"cpus": 1.0, "tpus": 4.0, "slice_hosts": 2}
+        )
+        im.set_target(1)
+        assert im.wait_running(1, timeout=60.0), im.counts()
+        alive = [n for n in rt._gcs.call("list_nodes") if n["Alive"]]
+        slice_nodes = [
+            n for n in alive if (n.get("Labels") or {}).get("slice_name")
+        ]
+        assert len(slice_nodes) == 2
+        assert {n["Labels"]["slice_name"] for n in slice_nodes} == {
+            slice_nodes[0]["Labels"]["slice_name"]
+        }
+        assert sorted(int(n["Labels"]["worker_index"]) for n in slice_nodes) == [0, 1]
+        assert all(n["Resources"].get("TPU") == 4.0 for n in slice_nodes)
+        im.set_target(0)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            im.reconcile()
+            if len([n for n in rt._gcs.call("list_nodes") if n["Alive"]]) == 1:
                 break
             time.sleep(0.2)
         assert len([n for n in rt._gcs.call("list_nodes") if n["Alive"]]) == 1

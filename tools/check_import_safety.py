@@ -1,0 +1,102 @@
+"""Import-safety check: no ray_tpu module may initialize a JAX backend
+(or do any other blocking accelerator discovery) at import time.
+
+The class of bug this guards against: the r5 dryrun rc:124 — a module
+touching `jax.devices()` on import wedges every importer when the TPU
+tunnel is down, because backend init HANGS rather than raising.
+
+Mechanism: run with `JAX_PLATFORMS` pinned to a platform name that does
+not exist. Importing jax (and using jax.numpy types in annotations etc.)
+stays legal, but the first backend resolution raises immediately instead
+of probing hardware — so any module that initializes a backend at import
+time fails loudly here, and hangs never happen. Then double-check the
+canary actually fires.
+
+Run directly (CI) or through tests/test_import_safety.py:
+
+    python tools/check_import_safety.py
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import pkgutil
+import subprocess
+import sys
+
+CANARY_PLATFORM = "ray_tpu_import_safety_canary"
+
+# Running as `python tools/check_import_safety.py` puts tools/ (not the
+# repo root) on sys.path; the package under test must resolve regardless.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# Modules whose import is legitimately side-effectful beyond python code
+# (native build tooling); everything else in the package must import clean.
+SKIP = {
+    "ray_tpu.native.build",
+}
+
+
+def iter_module_names() -> list:
+    import ray_tpu
+
+    names = ["ray_tpu"]
+    for info in pkgutil.walk_packages(ray_tpu.__path__, prefix="ray_tpu."):
+        if info.name in SKIP or "._build" in info.name:
+            continue
+        names.append(info.name)
+    return sorted(names)
+
+
+def check() -> int:
+    assert os.environ.get("JAX_PLATFORMS") == CANARY_PLATFORM, (
+        "run me via main() — the canary platform must be set before "
+        "any jax import"
+    )
+    failed = []
+    for name in iter_module_names():
+        try:
+            importlib.import_module(name)
+        except Exception as e:  # noqa: BLE001
+            failed.append((name, repr(e)))
+    if failed:
+        print("modules with import-time backend init (or import errors):")
+        for name, err in failed:
+            print(f"  {name}: {err}")
+        return 1
+    # Verify the canary is live: if jax resolved a backend anyway, the
+    # whole check was vacuous (e.g. a future jax ignoring JAX_PLATFORMS).
+    import jax
+
+    try:
+        jax.devices()
+    except Exception:
+        pass  # expected: unknown platform cannot initialize
+    else:
+        print("canary failed: jax.devices() succeeded under a bogus platform")
+        return 2
+    print(f"import safety OK: {len(iter_module_names())} modules, no backend init")
+    return 0
+
+
+def main() -> int:
+    if os.environ.get("_RAY_TPU_IMPORT_SAFETY_CHILD") == "1":
+        return check()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = CANARY_PLATFORM
+    env["_RAY_TPU_IMPORT_SAFETY_CHILD"] = "1"
+    # A hang IS the failure mode being guarded against: bound the child.
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env,
+        timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())
