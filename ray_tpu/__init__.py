@@ -9,6 +9,8 @@ collectives compile into XLA programs over the ICI mesh instead of NCCL.
 from ._version import version as __version__  # noqa: F401
 from . import exceptions  # noqa: F401
 from .api import (  # noqa: F401
+    InputNode,
+    MultiOutputNode,
     ObjectRef,
     available_resources,
     broadcast,
@@ -33,10 +35,14 @@ from .core.placement_group import (  # noqa: F401
     placement_group,
     remove_placement_group,
 )
+from . import cgraph  # noqa: F401  (compiled-graph data plane)
 
 __all__ = [
     "__version__",
     "broadcast",
+    "cgraph",
+    "InputNode",
+    "MultiOutputNode",
     "init",
     "shutdown",
     "is_initialized",

@@ -49,7 +49,20 @@ __all__ = [
     "available_resources",
     "nodes",
     "ObjectRef",
+    "InputNode",
+    "MultiOutputNode",
 ]
+
+
+def __getattr__(name: str):
+    # DAG authoring surface re-exported here (reference: ray.dag exposes
+    # InputNode/MultiOutputNode at the top level). Lazy: dag.py imports
+    # this module, so an eager import would cycle.
+    if name in ("InputNode", "MultiOutputNode"):
+        from . import dag
+
+        return getattr(dag, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 _VALID_OPTIONS = {
     "num_cpus",

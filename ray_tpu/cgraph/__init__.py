@@ -1,0 +1,56 @@
+"""Compiled graphs: the device-channel data plane between actor gangs.
+
+Re-design of the reference's Compiled Graphs / aDAG subsystem (reference:
+python/ray/dag/compiled_dag_node.py:664 experimental_compile,
+python/ray/experimental/channel/* for the channel plane,
+python/ray/experimental/collective/* for collective edges). A DAG of
+bound actor-method calls is type-checked and topologically compiled ONCE
+into a static plan; every cross-process edge gets one persistent channel
+(shm ring intra-node, TCP inter-node — core/channel.py); each
+participating actor hosts a long-running executor loop; and steady-state
+`compiled.execute(*args)` is a channel write plus a channel read — zero
+GCS round-trips and zero object-store traffic per iteration.
+
+Out-of-band **collective edges** bind a collective group (collective.py)
+to an actor gang at compile time via `TpuCommunicator`:
+`cgraph.allreduce.bind([...])` / `cgraph.reduce_scatter.bind([...])` /
+`cgraph.p2p.bind(node, dst_actor)` move arrays over the collective
+transport — the psum-over-ICI path on TPU slices, a socket ring on CPU
+CI — instead of per-call serialization through the driver.
+
+    import ray_tpu as rt
+    from ray_tpu.dag import InputNode
+    from ray_tpu import cgraph
+
+    with InputNode() as inp:
+        shards = [w.grad.bind(inp) for w in workers]
+        reduced = cgraph.allreduce.bind(shards)
+        dag = MultiOutputNode([w.apply.bind(g) for w, g in zip(workers, reduced)])
+    compiled = cgraph.compile(dag, max_inflight=4)
+    ref = compiled.execute(batch)
+    out = ref.get()
+    compiled.teardown()
+"""
+
+from .compile import CompiledGraph, CompiledRef, compile  # noqa: F401
+from .communicator import (  # noqa: F401
+    CollectiveNode,
+    TpuCommunicator,
+    allreduce,
+    p2p,
+    reduce_scatter,
+)
+from .plan import GraphPlan, build_plan  # noqa: F401
+
+__all__ = [
+    "CompiledGraph",
+    "CompiledRef",
+    "compile",
+    "CollectiveNode",
+    "TpuCommunicator",
+    "allreduce",
+    "reduce_scatter",
+    "p2p",
+    "GraphPlan",
+    "build_plan",
+]

@@ -1,0 +1,301 @@
+"""Driver half of the compiled-graph data plane.
+
+Re-design of the reference's CompiledDAG driver object (reference:
+compiled_dag_node.py:664 experimental_compile, execute:2118,
+CompiledDAGRef; channels shared_memory_channel.py:159). Compilation
+happens ONCE: the plan is built (cgraph/plan.py), every cross-process
+edge gets a persistent channel, gang communicators initialize on their
+members, and each participating actor starts a resident exec loop
+(cgraph/executor.py). After that, `execute()` is a channel write and
+`CompiledRef.get()` a channel read — zero task submissions, zero GCS
+round-trips, zero object-store traffic per iteration.
+
+`max_inflight` bounds the pipeline depth: execute() reclaims a completed
+round before admitting a new one once that many iterations are in the
+channels (backpressure against an unbounded producer).
+"""
+
+from __future__ import annotations
+
+import tempfile
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import api
+from ..core.channel import ChannelClosed, ChannelReader, ChannelWriter
+from ..dag import DAGNode
+from ..utils import internal_metrics as imet
+from .communicator import TpuCommunicator
+from .executor import DagError
+from .plan import GraphPlan, build_plan
+
+DEFAULT_MAX_INFLIGHT = 32
+
+
+class CompiledRef:
+    """Handle to one in-flight compiled-graph execution (reference:
+    compiled_dag_node.py CompiledDAGRef). `rt.get(ref)` / `ref.get()`
+    blocks on the output channel; results may be fetched out of order
+    (later seqs buffer earlier arrivals)."""
+
+    _is_channel_dag_ref = True
+
+    def __init__(self, graph: "CompiledGraph", seq: int):
+        self._graph = graph
+        self._seq = seq
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        return self._graph._fetch(self._seq, timeout)
+
+
+class CompiledGraph:
+    """A DAG compiled onto persistent channels + collective edges.
+
+    compile-time: build_plan() type-checks and topologically compiles the
+    graph into per-actor plans; actors host readers for their in-edges;
+    the driver hosts readers for DAG outputs; gang communicators bind to
+    their members; exec loops start; writers attach. Values between nodes
+    on the SAME actor never touch a channel; values across a collective
+    edge never touch a channel at all.
+
+    Caveat (same as the reference): while compiled, participating actors'
+    DAG methods run on the exec-loop thread, outside the actor's normal
+    concurrency serialization.
+    """
+
+    def __init__(
+        self,
+        root: DAGNode,
+        capacity: int = 8 << 20,
+        max_inflight: int = DEFAULT_MAX_INFLIGHT,
+        max_message: int = 0,
+    ):
+        if max_inflight is not None and max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        self._dag_id = uuid.uuid4().hex
+        self._plan: GraphPlan = build_plan(
+            root, self._dag_id, int(capacity), int(max_message)
+        )
+        self._max_inflight = max_inflight
+        self._seq = 0
+        self._next_read = 0
+        self._buffer: Dict[int, Any] = {}
+        self._partial_round: Dict[int, Any] = {}
+        self._t0: Dict[int, float] = {}
+        self._torn_down = False
+        self._broken: Optional[str] = None
+        self._handles = self._plan.handles
+        # Per-graph labels: cardinality grows with compiles per process
+        # lifetime (compiled graphs are long-lived by design — one per
+        # pipeline, thousands of iterations each). A driver that churns
+        # compiles should reuse graphs, not recompile per iteration.
+        self._m_latency = imet.CGRAPH_EXECUTE_LATENCY.labels(graph=self._dag_id[:8])
+        self._m_execs = imet.CGRAPH_EXECUTIONS.labels(graph=self._dag_id[:8])
+
+        # ---- wire up: setup (actors host in-edge readers) -> driver
+        # readers -> communicators -> start (actors attach writers + loops)
+        # -> driver writers.
+        specs: Dict[str, Any] = {}
+        self._out_readers: List[Tuple[int, ChannelReader]] = []
+        self._in_writers: List[Tuple[int, ChannelWriter]] = []
+        self._comms: List[TpuCommunicator] = []
+        set_up: List[Any] = []  # actors whose contexts need undo on failure
+        try:
+            for a, h in self._handles.items():
+                ref = h._invoke(
+                    "__ray_dag_setup__",
+                    (self._dag_id, self._plan.actor_plans[a]),
+                    {},
+                    1,
+                )
+                set_up.append(h)
+                specs.update(api.get(ref, timeout=60))
+            tmp = tempfile.gettempdir()
+            for nid, eid in self._plan.out_edge_ids.items():
+                r = ChannelReader(
+                    tmp, capacity=self._plan.capacity, max_message=self._plan.max_message
+                )
+                specs[eid] = r.spec()
+                self._out_readers.append((nid, r))
+            for cp in self._plan.comms:
+                comm = cp.build(self._handles)
+                self._comms.append(comm)
+                comm.ensure_initialized()
+            for a, h in self._handles.items():
+                mine = {
+                    e["edge_id"]: specs[e["edge_id"]]
+                    for e in self._plan.actor_plans[a]["out_edges"]
+                }
+                api.get(
+                    h._invoke("__ray_dag_start__", (self._dag_id, mine), {}, 1),
+                    timeout=60,
+                )
+            self._in_writers = [
+                (
+                    input_nid,
+                    ChannelWriter(
+                        specs[eid], metrics_label=self._plan.edge_label(eid)
+                    ),
+                )
+                for eid, input_nid in self._plan.input_edges
+            ]
+        except BaseException:
+            # A partial compile must not leak contexts/exec threads/ring
+            # files on the actors that DID set up (or driver readers).
+            for h in set_up:
+                try:
+                    api.get(
+                        h._invoke("__ray_dag_stop__", (self._dag_id,), {}, 1),
+                        timeout=10,
+                    )
+                except Exception:
+                    pass
+            for comm in self._comms:
+                comm.destroy()
+            for _, r in self._out_readers:
+                r.close()
+            raise
+
+    # ------------------------------------------------------------ execution
+    @property
+    def inflight(self) -> int:
+        """Iterations written but not yet drained from the output channels."""
+        return self._seq - self._next_read
+
+    def execute(self, *input_values) -> CompiledRef:
+        if self._torn_down:
+            raise RuntimeError("compiled graph was torn down")
+        if self._broken:
+            raise ChannelClosed(self._broken)
+        if len(input_values) != len(self._plan.inputs):
+            raise ValueError(
+                f"DAG takes {len(self._plan.inputs)} input(s), "
+                f"got {len(input_values)}"
+            )
+        # Pipeline-depth backpressure: reclaim completed rounds into the
+        # driver buffer before admitting a new iteration.
+        while self._max_inflight is not None and self.inflight >= self._max_inflight:
+            self._read_round(timeout=60.0)
+        by_input = {
+            n._id: v for n, v in zip(self._plan.inputs, input_values)
+        }
+        for i, (input_nid, w) in enumerate(self._in_writers):
+            try:
+                w.write(by_input[input_nid], timeout=60.0)
+            except ChannelClosed:
+                self._broken = (
+                    f"compiled graph {self._dag_id[:8]}: input channel closed "
+                    "(a participating actor died or the graph was torn down)"
+                )
+                self.teardown()
+                raise ChannelClosed(self._broken)
+            except BaseException:
+                if i > 0:
+                    # Earlier edges were written: actors are now one
+                    # iteration out of step — every future result would be
+                    # silently mispaired. Fail the DAG loudly.
+                    self.teardown()
+                    raise RuntimeError(
+                        "compiled graph input write failed after a partial "
+                        "write; the pipeline is desynchronized and has "
+                        "been torn down — recompile the DAG"
+                    )
+                raise
+        ref = CompiledRef(self, self._seq)
+        self._t0[self._seq] = time.perf_counter()
+        self._m_execs.inc()
+        self._seq += 1
+        return ref
+
+    def _read_round(self, timeout: Optional[float]) -> None:
+        """Drains one full output round (one value per output channel)
+        into the driver buffer."""
+        # Partial-round state persists across calls: a timeout after
+        # reading some output channels must NOT discard those values,
+        # or a retried get() would pair channel A's iteration k+1 with
+        # channel B's iteration k forever after.
+        vals = self._partial_round
+        try:
+            for nid, r in self._out_readers:
+                if nid not in vals:
+                    vals[nid] = r.read(timeout=timeout)  # None blocks
+        except ChannelClosed:
+            broken = (
+                f"compiled graph {self._dag_id[:8]}: output channel closed "
+                "(a participating actor died or the graph was torn down)"
+            )
+            if self._broken is None:
+                self._broken = broken
+                # Tear down NOW, not at the user's leisure: surviving
+                # actors' exec threads may be wedged inside a gang
+                # collective waiting on the dead member — only
+                # comm.destroy() (severing the ring) releases them, and
+                # the __cgraph__ GCS rank keys must not leak.
+                self.teardown()
+            raise ChannelClosed(broken)
+        self._partial_round = {}
+        assembled = [vals[nid] for nid in self._plan.output_order]
+        result = assembled if self._plan.is_multi_output else assembled[0]
+        t0 = self._t0.pop(self._next_read, None)
+        if t0 is not None:
+            self._m_latency.observe((time.perf_counter() - t0) * 1e3)
+        self._buffer[self._next_read] = result
+        self._next_read += 1
+
+    def _fetch(self, seq: int, timeout: Optional[float]) -> Any:
+        while seq not in self._buffer:
+            if self._broken and seq >= self._next_read:
+                raise ChannelClosed(self._broken)
+            self._read_round(timeout)
+        result = self._buffer.pop(seq)
+        err = None
+        if isinstance(result, DagError):
+            err = result
+        elif isinstance(result, list):
+            err = next((v for v in result if isinstance(v, DagError)), None)
+        if err is not None:
+            raise err.error
+        return result
+
+    def teardown(self) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        for h in self._handles.values():
+            try:
+                api.get(h._invoke("__ray_dag_stop__", (self._dag_id,), {}, 1), timeout=30)
+            except Exception:
+                pass  # actor may already be dead
+        for comm in self._comms:
+            comm.destroy()
+        for _, w in self._in_writers:
+            w.close()
+        for _, r in self._out_readers:
+            r.close()
+
+    def __enter__(self) -> "CompiledGraph":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.teardown()
+        return False
+
+
+def compile(
+    dag: DAGNode,
+    *,
+    buffer_size_bytes: int = 8 << 20,
+    max_inflight: int = DEFAULT_MAX_INFLIGHT,
+    max_message_bytes: int = 0,
+) -> CompiledGraph:
+    """Compiles a bound actor-method DAG onto the channel data plane
+    (reference: dag.experimental_compile). `buffer_size_bytes` sizes each
+    ring; `max_message_bytes` (optional) fails compilation up front if a
+    declared message could not fit; `max_inflight` bounds pipeline depth."""
+    return CompiledGraph(
+        dag,
+        capacity=buffer_size_bytes,
+        max_inflight=max_inflight,
+        max_message=max_message_bytes,
+    )

@@ -98,8 +98,13 @@ class _Group:
         import os
 
         host = os.environ.get("RAY_TPU_NODE_IP") or "127.0.0.1"
+        # Remember exactly what we registered: destroy() only deletes the
+        # key while it still holds OUR address, so tearing down a stale
+        # group can never erase a successor's fresh registration (the
+        # re-init-same-name deadlock).
+        self._addr_str = f"{host}:{port}"
         self._gcs.call(
-            "kv_put", f"{_KV_PREFIX}{name}/{rank}", f"{host}:{port}".encode()
+            "kv_put", f"{_KV_PREFIX}{name}/{rank}", self._addr_str.encode()
         )
         self._next: Optional[socket.socket] = None  # to (rank+1) % ws
         self._prev: Optional[socket.socket] = None  # from (rank-1) % ws
@@ -136,12 +141,23 @@ class _Group:
 
         t = threading.Thread(target=do_accept, daemon=True)
         t.start()
-        addr = self._lookup((self.rank + 1) % self.world_size)
+        next_rank = (self.rank + 1) % self.world_size
         deadline = time.monotonic() + 60.0
         last = None
+        addr = None
         while time.monotonic() < deadline:
+            # Re-resolve the neighbor EVERY retry: after an actor restart
+            # the KV may briefly hold the dead incarnation's address, and
+            # retrying a frozen stale addr for the whole deadline is the
+            # classic stale-rank deadlock. The fresh registration
+            # overwrites the key; the next lookup picks it up.
             try:
-                s = socket.create_connection(addr, timeout=5.0)
+                addr = self._lookup(next_rank, timeout=5.0)
+            except TimeoutError as e:
+                last = e
+                continue
+            try:
+                s = socket.create_connection(addr, timeout=2.0)
                 break
             except OSError as e:
                 last = e
@@ -279,12 +295,25 @@ class _Group:
                 self._send_next((kind, dst, payload))  # forward along the ring
 
     def destroy(self) -> None:
+        """Closes member sockets and deregisters this rank from the GCS
+        rendezvous. Guarded delete: a successor group under the same
+        (name, rank) may already have registered — deleting ITS key would
+        strand its peers' lookups (the re-init deadlock this fixes)."""
+        key = f"{_KV_PREFIX}{self.name}/{self.rank}"
         try:
-            self._gcs.call("kv_del", f"{_KV_PREFIX}{self.name}/{self.rank}")
+            cur = self._gcs.call("kv_get", key)
+            if cur is not None and cur.decode() == getattr(self, "_addr_str", None):
+                self._gcs.call("kv_del", key)
         except Exception:
             pass
         for s in (self._next, self._prev, self._srv):
             if s is not None:
+                # shutdown() first: close() alone does not reliably wake a
+                # thread blocked in recv() on the same socket.
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
                 try:
                     s.close()
                 except OSError:
@@ -304,12 +333,17 @@ def init_collective_group(
     actor/task (reference: util/collective/collective.py:120)."""
     if backend != "dcn":
         raise ValueError(f"unknown backend {backend!r}; the TPU build has 'dcn'")
-    g = _Group(world_size, rank, group_name)
+    # Tear down any previous membership BEFORE registering the new one:
+    # destroying the old group after the new _Group has kv_put its address
+    # used to delete the fresh key (same name/rank), leaving peers polling
+    # a registration that no longer exists — deadlock on re-init.
     with _GROUPS_LOCK:
         old = _GROUPS.pop(group_name, None)
-        _GROUPS[group_name] = g
     if old is not None:
         old.destroy()
+    g = _Group(world_size, rank, group_name)
+    with _GROUPS_LOCK:
+        _GROUPS[group_name] = g
 
 
 def _group(name: str) -> _Group:
@@ -358,15 +392,54 @@ def destroy_collective_group(group_name: str = "default") -> None:
         g.destroy()
 
 
+def _clear_stale_registrations(group_name: str) -> None:
+    """Deletes leftover rank->addr keys for a group (members that died
+    without destroy); fresh members re-register, and the per-retry
+    re-lookup in _establish_ring tolerates the brief gap."""
+    from .core.runtime_base import maybe_runtime
+
+    gcs = getattr(maybe_runtime(), "_gcs", None)
+    if gcs is None:
+        return
+    try:
+        for key in gcs.call("kv_keys", f"{_KV_PREFIX}{group_name}/"):
+            gcs.call("kv_del", key)
+    except Exception:
+        pass
+
+
 def create_collective_group(actors, group_name: str = "default") -> None:
     """Driver-side convenience: initializes the group on a list of actor
     handles, rank = list position (reference: collective.py:40
-    create_collective_group declarative path)."""
+    create_collective_group declarative path). Clears stale GCS
+    registrations first so a group re-created after member crashes
+    cannot rendezvous against dead addresses."""
     from . import api
 
+    _clear_stale_registrations(group_name)
     ws = len(actors)
     refs = [
         a._invoke("__ray_tpu_collective_init__", (ws, i, group_name), {}, 1)
         for i, a in enumerate(actors)
     ]
     api.get(refs, timeout=120)
+
+
+def destroy_collective_group_on(actors, group_name: str = "default") -> None:
+    """Driver-side teardown pair of create_collective_group: drops the
+    membership inside every member actor and deregisters their ranks."""
+    from . import api
+
+    refs = [
+        a._invoke("__ray_tpu_collective_destroy__", (group_name,), {}, 1)
+        for a in actors
+    ]
+    try:
+        api.get(refs, timeout=60)
+    except Exception:
+        pass  # members may already be dead; their keys are guard-deleted
+    # No blanket key sweep here: each member's destroy() deletes its own
+    # key only while it still holds that member's address, so a same-name
+    # group being re-created concurrently keeps its fresh registrations
+    # (create_collective_group sweeps stale keys on the CREATE side,
+    # where the new owner's intent is unambiguous).

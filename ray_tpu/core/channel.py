@@ -36,14 +36,55 @@ import time
 import uuid
 from typing import Any, Optional
 
-_HDR = struct.Struct("<QQI")  # write_pos, read_pos, closed
+_HDR = struct.Struct("<QQII")  # write_pos, read_pos, reader_closed, writer_closed
 _LEN = struct.Struct("<I")
 _WRAP = 0xFFFFFFFF
 _DATA_OFF = 64  # header page; positions are offsets into the data region
 
+# Floor below which a ring cannot hold even one tiny record on each side
+# of the half-capacity rule; ChannelSpec rejects these at build time.
+MIN_CAPACITY = 64
+
+# A blocked reader/writer re-checks the shared header at least this often
+# even if its wakeup socket never fires: peer close is detected promptly
+# whether or not the close managed to send a token (bounded poll).
+_POLL_S = 0.2
+
 
 class ChannelClosed(Exception):
     """The peer closed the channel (teardown or process death)."""
+
+
+def _align(n: int) -> int:
+    return (n + 7) & ~7
+
+
+def required_capacity(max_message: int) -> int:
+    """Smallest ring capacity that can carry a `max_message`-byte payload.
+
+    Records are capped at half the capacity (see ChannelWriter.write_bytes:
+    the wrap-tail + record must fit an empty ring), so the requirement is
+    2x one aligned framed record."""
+    return max(MIN_CAPACITY, 2 * _align(_LEN.size + int(max_message)))
+
+
+def validate_capacity(capacity: int, max_message: int = 0) -> int:
+    """Validates a channel buffer size up front (compile time) instead of
+    letting the first oversized write fail mid-pipeline."""
+    if not isinstance(capacity, int) or isinstance(capacity, bool):
+        raise TypeError(f"channel capacity must be an int, got {type(capacity).__name__}")
+    if capacity < MIN_CAPACITY:
+        raise ValueError(
+            f"channel capacity {capacity} below minimum {MIN_CAPACITY}"
+        )
+    need = required_capacity(max_message) if max_message else MIN_CAPACITY
+    if capacity < need:
+        raise ValueError(
+            f"channel capacity {capacity} cannot hold one aligned "
+            f"{max_message}-byte message (records are capped at half the "
+            f"capacity; need >= {need})"
+        )
+    return capacity
 
 
 class ChannelSpec:
@@ -52,6 +93,7 @@ class ChannelSpec:
     __slots__ = ("name", "ring_path", "uds_path", "tcp_addr", "capacity")
 
     def __init__(self, name, ring_path, uds_path, tcp_addr, capacity):
+        validate_capacity(capacity)
         self.name = name
         self.ring_path = ring_path
         self.uds_path = uds_path
@@ -63,10 +105,6 @@ class ChannelSpec:
             ChannelSpec,
             (self.name, self.ring_path, self.uds_path, self.tcp_addr, self.capacity),
         )
-
-
-def _align(n: int) -> int:
-    return (n + 7) & ~7
 
 
 class _Ring:
@@ -84,7 +122,7 @@ class _Ring:
         finally:
             os.close(fd)
         if create:
-            _HDR.pack_into(self.mm, 0, 0, 0, 0)
+            _HDR.pack_into(self.mm, 0, 0, 0, 0, 0)
 
     # positions are monotonic; offset = pos % capacity
     def header(self):
@@ -99,8 +137,11 @@ class _Ring:
     def set_read_pos(self, pos: int):
         struct.pack_into("<Q", self.mm, 8, pos)
 
-    def set_closed(self):
+    def set_reader_closed(self):
         struct.pack_into("<I", self.mm, 16, 1)
+
+    def set_writer_closed(self):
+        struct.pack_into("<I", self.mm, 20, 1)
 
     def write_record(self, wpos: int, payload) -> int:
         """Writes one record at wpos (caller checked space); returns new wpos."""
@@ -178,7 +219,14 @@ def _token(sock: Optional[socket.socket]) -> None:
 class ChannelReader:
     """Reader end; hosts the ring + listener. One reader per channel."""
 
-    def __init__(self, session_dir: str, name: Optional[str] = None, capacity: int = 8 << 20):
+    def __init__(
+        self,
+        session_dir: str,
+        name: Optional[str] = None,
+        capacity: int = 8 << 20,
+        max_message: int = 0,
+    ):
+        validate_capacity(capacity, max_message)
         self.name = name or uuid.uuid4().hex[:12]
         self.capacity = capacity
         self._closed = False
@@ -241,21 +289,27 @@ class ChannelReader:
     def _read_ring(self, timeout: Optional[float]) -> bytes:
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            wpos, rpos, closed = self._ring.header()
+            wpos, rpos, rclosed, wclosed = self._ring.header()
             if wpos != rpos:
                 payload, new_rpos = self._ring.read_record(rpos)
                 self._ring.set_read_pos(new_rpos)
                 _token(self._conn)  # credit: unblock a full writer
                 return payload
-            if closed:
+            if rclosed or wclosed:
+                # Peer (or we) closed and the ring is drained: surface it
+                # rather than waiting for data that can never arrive.
                 raise ChannelClosed(self.name)
             remain = None if deadline is None else max(0.0, deadline - time.monotonic())
-            r, _, _ = select.select([self._conn], [], [], remain)
-            if not r:
+            if remain is not None and remain <= 0.0:
                 raise TimeoutError(f"channel {self.name}: empty after {timeout}s")
-            if not _drain(self._conn):
+            # Bounded poll: the writer-closed flag is written without a
+            # guaranteed token (the close may race socket teardown), so
+            # never sleep unboundedly on the wakeup socket alone.
+            wait = _POLL_S if remain is None else min(remain, _POLL_S)
+            r, _, _ = select.select([self._conn], [], [], wait)
+            if r and not _drain(self._conn):
                 # Writer hung up; drain anything it published first.
-                wpos, rpos, closed = self._ring.header()
+                wpos, rpos, rclosed, wclosed = self._ring.header()
                 if wpos == rpos:
                     raise ChannelClosed(self.name)
 
@@ -283,8 +337,11 @@ class ChannelReader:
             raise TimeoutError(f"channel {self.name}: empty after {timeout}s")
 
     def close(self) -> None:
+        if self._closed:
+            return  # idempotent: teardown and loop-exit cascade both close
         self._closed = True
-        self._ring.set_closed()
+        with contextlib.suppress(Exception):
+            self._ring.set_reader_closed()
         for s in (self._conn, self._stream, self._uds_srv, self._tcp_srv):
             if s is not None:
                 with contextlib.suppress(OSError):
@@ -296,14 +353,35 @@ class ChannelReader:
 
 
 class ChannelWriter:
-    """Writer end; attaches to a reader-hosted channel by descriptor."""
+    """Writer end; attaches to a reader-hosted channel by descriptor.
 
-    def __init__(self, spec: ChannelSpec, connect_timeout: float = 20.0):
+    `metrics_label` (optional) turns on data-plane instrumentation: bytes
+    and messages written plus the ring occupancy high-water mark flow to
+    utils/internal_metrics tagged with that label (the compiled-graph
+    layer labels each edge)."""
+
+    def __init__(
+        self,
+        spec: ChannelSpec,
+        connect_timeout: float = 20.0,
+        metrics_label: Optional[str] = None,
+    ):
         self.spec = spec
         self._closed = False
         self._ring: Optional[_Ring] = None
         self._sock: Optional[socket.socket] = None
         self._stream: Optional[socket.socket] = None
+        self._m_msgs = self._m_bytes = self._m_hwm = None
+        self._hwm = 0
+        if metrics_label:
+            try:
+                from ..utils import internal_metrics as imet
+
+                self._m_msgs = imet.CGRAPH_CHANNEL_MSGS.labels(channel=metrics_label)
+                self._m_bytes = imet.CGRAPH_CHANNEL_BYTES.labels(channel=metrics_label)
+                self._m_hwm = imet.CGRAPH_RING_HWM.labels(channel=metrics_label)
+            except Exception:
+                pass  # instrumentation must never break the data plane
         deadline = time.monotonic() + connect_timeout
         last: Optional[Exception] = None
         while time.monotonic() < deadline:
@@ -338,6 +416,7 @@ class ChannelWriter:
                 raise TimeoutError(f"channel {self.spec.name}: peer stalled")
             except OSError:
                 raise ChannelClosed(self.spec.name)
+            self._record_write(len(payload), None)
             return
         ring = self._ring
         # Half-capacity record cap: guarantees wrap-tail + record always fit
@@ -350,26 +429,49 @@ class ChannelWriter:
             )
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            wpos, rpos, closed = ring.header()
-            if closed:
+            wpos, rpos, rclosed, _wclosed = ring.header()
+            if rclosed:
                 raise ChannelClosed(self.spec.name)
             need = ring.space_needed(wpos, len(payload))
             if ring.capacity - (wpos - rpos) >= need:
                 new_wpos = ring.write_record(wpos, payload)
                 ring.set_write_pos(new_wpos)
                 _token(self._sock)
+                self._record_write(len(payload), new_wpos - rpos)
                 return
             remain = None if deadline is None else max(0.0, deadline - time.monotonic())
-            r, _, _ = select.select([self._sock], [], [], remain)  # credit wait
-            if not r:
+            if remain is not None and remain <= 0.0:
                 raise TimeoutError(
                     f"channel {self.spec.name}: full after {timeout}s (backpressure)"
                 )
-            if not _drain(self._sock):
+            # Bounded credit wait: the reader-closed flag may be set
+            # without a reachable wakeup socket (reader died mid-close).
+            wait = _POLL_S if remain is None else min(remain, _POLL_S)
+            r, _, _ = select.select([self._sock], [], [], wait)
+            if r and not _drain(self._sock):
                 raise ChannelClosed(self.spec.name)
 
+    def _record_write(self, nbytes: int, occupancy) -> None:
+        if self._m_msgs is None:
+            return
+        self._m_msgs.inc()
+        self._m_bytes.inc(float(nbytes))
+        if occupancy is not None and occupancy > self._hwm:
+            self._hwm = occupancy
+            self._m_hwm.set(float(occupancy))
+
     def close(self) -> None:
+        if self._closed:
+            return
         self._closed = True
+        if self._ring is not None:
+            # Publish the close through the ring itself, then best-effort
+            # wake the reader: a reader blocked in read() must see
+            # ChannelClosed promptly even if the token never lands (its
+            # poll is bounded).
+            with contextlib.suppress(Exception):
+                self._ring.set_writer_closed()
+            _token(self._sock)
         for s in (self._sock, self._stream):
             if s is not None:
                 with contextlib.suppress(OSError):

@@ -1,171 +1,20 @@
-"""Actor-side compiled-DAG execution: channels in, user methods, channels out.
-
-Re-design of the reference's worker exec loop for compiled graphs
-(reference: python/ray/dag/compiled_dag_node.py:133 do_exec_tasks — a
-long-running framework task on each participating actor that loops
-{read input channels, run the bound method, write output channels} so
-steady-state DAG execution involves ZERO task submissions). Here the
-loop runs on a daemon thread inside the actor process (the actor stays
-responsive to normal calls), and the framework entry points ride the
-normal actor-task path under reserved `__ray_dag_*__` method names that
-the worker dispatches to this module instead of the user instance.
+"""Compatibility shim: the actor-side compiled-graph executor moved to
+`ray_tpu.cgraph.executor` when the compiled-graph data plane became its
+own subsystem (channels + collective edges). The worker's reserved
+`__ray_dag_*__` dispatch and older imports keep working through this
+module; new code should import from ray_tpu.cgraph.executor directly.
 """
 
 from __future__ import annotations
 
-import tempfile
-import threading
-import traceback
-from typing import Any, Dict, List
+from ..cgraph.executor import (  # noqa: F401
+    _CONTEXTS,
+    DagError,
+    GraphExecutor,
+    bind_builtin,
+)
 
-from .channel import ChannelClosed, ChannelReader, ChannelWriter
+# Former name for GraphExecutor.
+_DagContext = GraphExecutor
 
-
-class DagError:
-    """An exception captured at one node, forwarded through downstream
-    channels so every consumer (and finally the driver) sees it without
-    wedging the pipeline (reference: compiled_dag_node.py error
-    propagation via channel writes)."""
-
-    __slots__ = ("error", "node_desc", "tb")
-
-    def __init__(self, error: BaseException, node_desc: str, tb: str):
-        self.error = error
-        self.node_desc = node_desc
-        self.tb = tb
-
-
-class _DagContext:
-    """One compiled DAG's state inside one actor process."""
-
-    def __init__(self, inst: Any, plan: dict):
-        self.inst = inst
-        self.plan = plan
-        self.readers: Dict[str, ChannelReader] = {}
-        self.writers: Dict[str, ChannelWriter] = {}
-        self.stop = threading.Event()
-        self.thread: threading.Thread = None
-
-    def setup(self) -> Dict[str, Any]:
-        """Hosts a reader channel per in-edge; returns their specs."""
-        tmp = tempfile.gettempdir()
-        specs = {}
-        for e in self.plan["in_edges"]:
-            r = ChannelReader(tmp, capacity=self.plan["capacity"])
-            self.readers[e["edge_id"]] = r
-            specs[e["edge_id"]] = r.spec()
-        return specs
-
-    def start(self, writer_specs: Dict[str, Any]) -> None:
-        self.writers = {
-            e["edge_id"]: ChannelWriter(writer_specs[e["edge_id"]])
-            for e in self.plan["out_edges"]
-        }
-        self.thread = threading.Thread(
-            target=self._loop, daemon=True, name=f"dag-{self.plan['dag_id'][:8]}"
-        )
-        self.thread.start()
-
-    def teardown(self) -> None:
-        self.stop.set()
-        for r in self.readers.values():
-            r.close()
-        for w in self.writers.values():
-            w.close()
-
-    # ------------------------------------------------------------- the loop
-    def _loop(self) -> None:
-        """One iteration = one DAG execution. Reads/writes interleave PER
-        NODE in topo order (not read-all-then-run-all): an actor whose
-        later node consumes a value derived from its earlier node's output
-        via another actor (A->B->A) would deadlock under phase-batched
-        reads. All channels are FIFO, so iteration k's values line up
-        across the whole DAG without sequence numbers."""
-        nodes = self.plan["nodes"]
-        while not self.stop.is_set():
-            vals: Dict[int, Any] = {}
-            try:
-                for node in nodes:
-                    for r in node["reads"]:
-                        vals[r["src_node"]] = self.readers[r["edge_id"]].read()
-                    vals[node["node_id"]] = self._run_node(node, vals)
-                    out = vals[node["node_id"]]
-                    for eid in node["writes"]:
-                        try:
-                            self.writers[eid].write(out)
-                        except (ChannelClosed, OSError):
-                            raise
-                        except Exception as e:  # noqa: BLE001
-                            # Oversize record / unpicklable result: the
-                            # execution must still produce SOMETHING on
-                            # this edge or the whole DAG wedges — forward
-                            # a DagError instead (it is small and
-                            # picklable).
-                            self.writers[eid].write(
-                                DagError(e, node.get("desc", ""), traceback.format_exc())
-                            )
-            except (ChannelClosed, OSError):
-                break  # teardown raced a blocked read/write
-
-    def _run_node(self, node: dict, vals: Dict[int, Any]) -> Any:
-        def resolve(a):
-            if isinstance(a, tuple) and len(a) == 2 and a[0] == "__dag_ref__":
-                return vals[a[1]]
-            return a
-
-        args = [resolve(a) for a in node["args"]]
-        kwargs = {k: resolve(v) for k, v in node["kwargs"].items()}
-        # An upstream failure short-circuits this node and forwards.
-        for v in list(args) + list(kwargs.values()):
-            if isinstance(v, DagError):
-                return v
-        try:
-            method = getattr(self.inst, node["method"])
-            return method(*args, **kwargs)
-        except BaseException as e:  # noqa: BLE001
-            return DagError(e, node.get("desc", node["method"]), traceback.format_exc())
-
-
-# Per-worker-process registry: dag_id -> context.
-_CONTEXTS: Dict[str, _DagContext] = {}
-_LOCK = threading.Lock()
-
-
-def bind_builtin(inst: Any, name: str):
-    """Resolves a reserved `__ray_dag_*__` method name to a framework
-    callable bound to this actor instance (the worker's dispatch calls
-    this instead of getattr on the user object)."""
-
-    def _setup(dag_id: str, plan: dict):
-        ctx = _DagContext(inst, plan)
-        with _LOCK:
-            old = _CONTEXTS.pop(dag_id, None)
-            _CONTEXTS[dag_id] = ctx
-        if old is not None:
-            old.teardown()
-        return ctx.setup()
-
-    def _start(dag_id: str, writer_specs: dict):
-        with _LOCK:
-            ctx = _CONTEXTS.get(dag_id)
-        if ctx is None:
-            raise RuntimeError(f"dag {dag_id} was never set up on this actor")
-        ctx.start(writer_specs)
-        return True
-
-    def _stop(dag_id: str):
-        with _LOCK:
-            ctx = _CONTEXTS.pop(dag_id, None)
-        if ctx is not None:
-            ctx.teardown()
-        return True
-
-    table = {
-        "__ray_dag_setup__": _setup,
-        "__ray_dag_start__": _start,
-        "__ray_dag_stop__": _stop,
-    }
-    try:
-        return table[name]
-    except KeyError:
-        raise AttributeError(f"unknown DAG builtin {name!r}")
+__all__ = ["DagError", "GraphExecutor", "bind_builtin"]

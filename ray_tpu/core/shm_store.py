@@ -19,6 +19,7 @@ from typing import Any, Optional
 
 from .. import exceptions as exc
 from ..native.build import shm_pool_lib
+from ..utils import internal_metrics as imet
 from . import serialization
 from .ids import ObjectID
 
@@ -196,6 +197,7 @@ class SharedMemoryStore:
             pos += flat.nbytes
         del dst
         self._lib.rtpu_seal(self._handle, oid.binary())
+        imet.STORE_PUTS.inc()
 
     def put_raw(self, oid: ObjectID, data: bytes) -> None:
         """Stores pre-framed bytes (used by the transfer path)."""
@@ -211,6 +213,7 @@ class SharedMemoryStore:
             raise OSError(-rc, "rtpu_create failed")
         self._mv[off.value : off.value + len(data)] = data
         self._lib.rtpu_seal(self._handle, oid.binary())
+        imet.STORE_PUTS.inc()
 
     # --------------------------------------------- chunked transfer path
     def begin_put_raw(self, oid: ObjectID, size: int) -> Optional[int]:
@@ -235,6 +238,7 @@ class SharedMemoryStore:
 
     def finish_put_raw(self, oid: ObjectID) -> None:
         self._lib.rtpu_seal(self._handle, oid.binary())
+        imet.STORE_PUTS.inc()
 
     def raw_size(self, oid: ObjectID) -> Optional[int]:
         off = ctypes.c_uint64()

@@ -357,6 +357,17 @@ def main(argv: List[str]) -> None:
                 return True
 
             return _collective_init
+        if name == "__ray_tpu_collective_destroy__":
+            # Gang teardown entry used by cgraph communicators (and any
+            # driver-side group manager): drops this process's membership
+            # and deregisters its rank from the GCS rendezvous.
+            from ..collective import destroy_collective_group
+
+            def _collective_destroy(gname):
+                destroy_collective_group(gname)
+                return True
+
+            return _collective_destroy
         return getattr(inst, name)
 
     def run_body(entry: dict, sealed: List[str]) -> bool:

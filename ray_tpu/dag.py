@@ -6,19 +6,22 @@ compiled_dag_node.py:664 experimental_compile). The authoring surface
 matches: `fn.bind(x)`, `actor.method.bind(node)`, `MultiOutputNode`,
 `dag.execute(input)`.
 
-The reference's *compiled* DAGs exist to bypass its per-call RPC overhead
-with preallocated channels; the TPU-native counterpart of that role is
-the compiled SPMD program itself (see parallel/pipeline.py — stages,
-channels, and schedule all live inside one jitted computation).
-`compile()` here caches the topological plan so repeated execute() calls
-skip graph traversal, and intermediate results flow by ObjectRef (zero
-serialization of values through the driver).
+Two compilation tiers:
+
+- `compile()` caches the topological plan so repeated execute() calls
+  skip graph traversal; intermediate results flow by ObjectRef (zero
+  serialization of values through the driver) but every hop still pays
+  task submission.
+- `experimental_compile()` hands the graph to the cgraph subsystem
+  (ray_tpu/cgraph/): one persistent channel per edge, a resident exec
+  loop per participating actor, optional collective edges — steady-state
+  execution is a channel write + read, ZERO task submissions.
 """
 
 from __future__ import annotations
 
 import itertools
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Tuple
 
 from . import api
 
@@ -71,14 +74,24 @@ class DAGNode:
         return CompiledDAG(self)
 
     def experimental_compile(
-        self, buffer_size_bytes: int = 8 << 20
-    ) -> "ChannelCompiledDAG":
-        """Compiles an actor-method DAG onto preallocated channels with a
-        resident exec loop on every participating actor: steady-state
-        `execute()` is a channel write + read — ZERO task submissions
-        (reference: compiled_dag_node.py:664 experimental_compile,
-        execute :2118; channels shared_memory_channel.py:159)."""
-        return ChannelCompiledDAG(self, buffer_size_bytes)
+        self,
+        buffer_size_bytes: int = 8 << 20,
+        max_inflight: int = 32,
+        max_message_bytes: int = 0,
+    ):
+        """Compiles an actor-method DAG onto the cgraph data plane:
+        preallocated channels, a resident exec loop on every participating
+        actor, bounded pipeline depth — steady-state `execute()` is a
+        channel write + read, ZERO task submissions (reference:
+        compiled_dag_node.py:664 experimental_compile, execute:2118)."""
+        from .cgraph.compile import CompiledGraph
+
+        return CompiledGraph(
+            self,
+            capacity=buffer_size_bytes,
+            max_inflight=max_inflight,
+            max_message=max_message_bytes,
+        )
 
 
 class InputNode(DAGNode):
@@ -152,289 +165,16 @@ class CompiledDAG:
         return results[self._root._id]
 
 
-# ------------------------------------------------------- channel-compiled DAG
+def __getattr__(name: str):
+    # Former in-module channel-compiled classes now live in the cgraph
+    # subsystem; resolve lazily (a module-level import would cycle:
+    # cgraph.compile imports this module for the node types).
+    if name == "ChannelCompiledDAG":
+        from .cgraph.compile import CompiledGraph
 
+        return CompiledGraph
+    if name == "ChannelDAGRef":
+        from .cgraph.compile import CompiledRef
 
-class ChannelDAGRef:
-    """Handle to one in-flight compiled-DAG execution (reference:
-    compiled_dag_node.py CompiledDAGRef). `rt.get(ref)` / `ref.get()`
-    blocks on the output channel; results may be fetched out of order
-    (later seqs buffer earlier arrivals)."""
-
-    _is_channel_dag_ref = True
-
-    def __init__(self, cdag: "ChannelCompiledDAG", seq: int):
-        self._cdag = cdag
-        self._seq = seq
-
-    def get(self, timeout: Optional[float] = None) -> Any:
-        return self._cdag._fetch(self._seq, timeout)
-
-
-class ChannelCompiledDAG:
-    """Driver half of the channel data plane.
-
-    compile-time: walks the graph, assigns every ClassMethodNode to its
-    actor, allocates one SPSC channel per cross-process edge (actors host
-    readers for their in-edges; the driver hosts readers for DAG outputs),
-    and installs an exec loop on each participating actor
-    (core/dag_exec.py). Values between nodes on the SAME actor never touch
-    a channel. execute() writes the input channels and hands back a ref;
-    get() reads the output channels. Teardown stops the loops and closes
-    everything.
-
-    Caveat (same as the reference): while compiled, participating actors'
-    DAG methods run on the exec-loop thread, outside the actor's normal
-    concurrency serialization.
-    """
-
-    def __init__(self, root: DAGNode, capacity: int):
-        import uuid as _uuid
-
-        self._root = root
-        self._capacity = int(capacity)
-        self._dag_id = _uuid.uuid4().hex
-        self._seq = 0
-        self._next_read = 0
-        self._buffer: Dict[int, Any] = {}
-        self._partial_round: Dict[int, Any] = {}
-        self._torn_down = False
-
-        topo = root._topo()
-        self._inputs = [n for n in topo if isinstance(n, InputNode)]
-        node_actor: Dict[int, str] = {}
-        handles: Dict[str, Any] = {}
-        for n in topo:
-            if isinstance(n, InputNode):
-                continue
-            if isinstance(n, MultiOutputNode):
-                if n is not root:
-                    raise ValueError("MultiOutputNode is only valid as the DAG root")
-                continue
-            if not isinstance(n, ClassMethodNode):
-                raise ValueError(
-                    "experimental_compile requires every compute node to be an "
-                    "actor method (plain @remote functions have no resident "
-                    "process to host an exec loop); use .compile() for those"
-                )
-            ahex = n._method._handle._actor_id.hex()
-            node_actor[n._id] = ahex
-            handles[ahex] = n._method._handle
-        if not handles:
-            raise ValueError("DAG has no actor-method nodes to compile")
-        self._handles = handles
-
-        plans: Dict[str, dict] = {
-            a: {
-                "dag_id": self._dag_id,
-                "nodes": [],
-                "in_edges": [],
-                "out_edges": [],
-                "capacity": self._capacity,
-            }
-            for a in handles
-        }
-        edge_seen: Dict[Tuple[int, str], str] = {}
-        # Edges the driver writes (DAG inputs): [(edge_id, input_node_id)].
-        self._input_edges: List[Tuple[str, int]] = []
-
-        def intern_edge(src: DAGNode, dst_actor: str, node_plan: dict) -> None:
-            key = (src._id, dst_actor)
-            if key in edge_seen:
-                return
-            eid = f"{self._dag_id}:{src._id}->{dst_actor[:8]}"
-            edge_seen[key] = eid
-            plans[dst_actor]["in_edges"].append(
-                {"edge_id": eid, "src_node": src._id}
-            )
-            node_plan["reads"].append({"edge_id": eid, "src_node": src._id})
-            if isinstance(src, InputNode):
-                self._input_edges.append((eid, src._id))
-
-        for n in topo:
-            if isinstance(n, (InputNode, MultiOutputNode)):
-                continue
-            a = node_actor[n._id]
-            node_plan = {
-                "node_id": n._id,
-                "method": n._method._method_name,
-                "desc": n._method._method_name,
-                "reads": [],
-                "writes": [],
-                "args": [],
-                "kwargs": {},
-            }
-
-            def mark(v):
-                if isinstance(v, MultiOutputNode):
-                    raise ValueError("MultiOutputNode cannot feed another node")
-                if isinstance(v, DAGNode):
-                    if isinstance(v, InputNode) or node_actor[v._id] != a:
-                        intern_edge(v, a, node_plan)
-                    return ("__dag_ref__", v._id)
-                return v
-
-            node_plan["args"] = [mark(x) for x in n._bound_args]
-            node_plan["kwargs"] = {k: mark(v) for k, v in n._bound_kwargs.items()}
-            if not any(
-                isinstance(v, DAGNode)
-                for v in list(n._bound_args) + list(n._bound_kwargs.values())
-            ):
-                # An ungated node has no channel read pacing its loop
-                # iteration — it would free-run (execute unboundedly, not
-                # once per execute()). The reference rejects these too.
-                raise ValueError(
-                    f"node {node_plan['method']!r} consumes no InputNode or "
-                    "upstream output; every compiled-DAG node must be gated "
-                    "by at least one dataflow edge"
-                )
-            plans[a]["nodes"].append(node_plan)
-
-        # DAG outputs: the driver hosts one reader per distinct output node.
-        outputs = (
-            [x for x in root._bound_args]
-            if isinstance(root, MultiOutputNode)
-            else [root]
-        )
-        for out in outputs:
-            if not isinstance(out, ClassMethodNode):
-                raise ValueError("DAG outputs must be actor-method nodes")
-        self._output_order = [out._id for out in outputs]
-        out_edge_ids: Dict[int, str] = {}
-        for out in outputs:
-            if out._id in out_edge_ids:
-                continue
-            out_edge_ids[out._id] = f"{self._dag_id}:{out._id}->driver"
-        # Producer-side writes: cross-actor edges + output edges, attached
-        # to the producing node so the loop writes right after it runs.
-        for a, plan in plans.items():
-            for node_plan in plan["nodes"]:
-                nid = node_plan["node_id"]
-                for (src, dst_actor), eid in edge_seen.items():
-                    if src == nid:
-                        node_plan["writes"].append(eid)
-                        plan["out_edges"].append({"edge_id": eid, "src_node": nid})
-                if nid in out_edge_ids:
-                    eid = out_edge_ids[nid]
-                    node_plan["writes"].append(eid)
-                    plan["out_edges"].append({"edge_id": eid, "src_node": nid})
-
-        # ---- wire up: setup (actors host in-edge readers) -> driver readers
-        # -> start (actors attach writers) -> driver writers.
-        import tempfile
-
-        from .core.channel import ChannelReader, ChannelWriter
-
-        specs: Dict[str, Any] = {}
-        self._out_readers: List[Tuple[int, ChannelReader]] = []
-        self._in_writers: List[Tuple[int, ChannelWriter]] = []
-        set_up: List[Any] = []  # actors whose contexts need undo on failure
-        try:
-            for a, h in handles.items():
-                ref = h._invoke("__ray_dag_setup__", (self._dag_id, plans[a]), {}, 1)
-                set_up.append(h)
-                specs.update(api.get(ref, timeout=60))
-            tmp = tempfile.gettempdir()
-            for nid, eid in out_edge_ids.items():
-                r = ChannelReader(tmp, capacity=self._capacity)
-                specs[eid] = r.spec()
-                self._out_readers.append((nid, r))
-            for a, h in handles.items():
-                mine = {
-                    e["edge_id"]: specs[e["edge_id"]] for e in plans[a]["out_edges"]
-                }
-                api.get(
-                    h._invoke("__ray_dag_start__", (self._dag_id, mine), {}, 1),
-                    timeout=60,
-                )
-            self._in_writers = [
-                (input_nid, ChannelWriter(specs[eid]))
-                for eid, input_nid in self._input_edges
-            ]
-        except BaseException:
-            # A partial compile must not leak contexts/exec threads/ring
-            # files on the actors that DID set up (or driver readers).
-            for h in set_up:
-                try:
-                    api.get(
-                        h._invoke("__ray_dag_stop__", (self._dag_id,), {}, 1),
-                        timeout=10,
-                    )
-                except Exception:
-                    pass
-            for _, r in self._out_readers:
-                r.close()
-            raise
-
-    # ------------------------------------------------------------ execution
-    def execute(self, *input_values) -> Any:
-        if self._torn_down:
-            raise RuntimeError("compiled DAG was torn down")
-        if len(input_values) != len(self._inputs):
-            raise ValueError(
-                f"DAG takes {len(self._inputs)} input(s), got {len(input_values)}"
-            )
-        by_input = {
-            n._id: v for n, v in zip(self._inputs, input_values)
-        }
-        for i, (input_nid, w) in enumerate(self._in_writers):
-            try:
-                w.write(by_input[input_nid], timeout=60.0)
-            except BaseException:
-                if i > 0:
-                    # Earlier edges were written: actors are now one
-                    # iteration out of step — every future result would be
-                    # silently mispaired. Fail the DAG loudly.
-                    self.teardown()
-                    raise RuntimeError(
-                        "compiled DAG input write failed after a partial "
-                        "write; the pipeline is desynchronized and has "
-                        "been torn down — recompile the DAG"
-                    )
-                raise
-        ref = ChannelDAGRef(self, self._seq)
-        self._seq += 1
-        return ref
-
-    def _fetch(self, seq: int, timeout: Optional[float]) -> Any:
-        from .core.dag_exec import DagError
-
-        while seq not in self._buffer:
-            # Partial-round state persists across calls: a timeout after
-            # reading some output channels must NOT discard those values,
-            # or a retried get() would pair channel A's iteration k+1 with
-            # channel B's iteration k forever after.
-            vals = self._partial_round
-            for nid, r in self._out_readers:
-                if nid not in vals:
-                    vals[nid] = r.read(timeout=timeout)  # None blocks
-            self._partial_round = {}
-            assembled = [vals[nid] for nid in self._output_order]
-            result = (
-                assembled if isinstance(self._root, MultiOutputNode) else assembled[0]
-            )
-            self._buffer[self._next_read] = result
-            self._next_read += 1
-        result = self._buffer.pop(seq)
-        err = None
-        if isinstance(result, DagError):
-            err = result
-        elif isinstance(result, list):
-            err = next((v for v in result if isinstance(v, DagError)), None)
-        if err is not None:
-            raise err.error
-        return result
-
-    def teardown(self) -> None:
-        if self._torn_down:
-            return
-        self._torn_down = True
-        for h in self._handles.values():
-            try:
-                api.get(h._invoke("__ray_dag_stop__", (self._dag_id,), {}, 1), timeout=30)
-            except Exception:
-                pass  # actor may already be dead
-        for _, w in self._in_writers:
-            w.close()
-        for _, r in self._out_readers:
-            r.close()
+        return CompiledRef
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

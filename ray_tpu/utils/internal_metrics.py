@@ -496,6 +496,43 @@ FASTPATH_RTT = Histogram(
     "Direct-push round trip: owner send to completion ack",
     component="fastpath",
 )
+# --- shm object store -----------------------------------------------------
+STORE_PUTS = Counter(
+    "raytpu_store_puts_total",
+    "Objects written into the shm object store by this process",
+    component="object_transport",
+)
+# --- compiled-graph data plane (cgraph) -----------------------------------
+CGRAPH_CHANNEL_MSGS = Counter(
+    "raytpu_cgraph_channel_msgs_total",
+    "Messages written per compiled-graph channel edge",
+    component="cgraph",
+    tag_keys=("channel",),
+)
+CGRAPH_CHANNEL_BYTES = Counter(
+    "raytpu_cgraph_channel_bytes_total",
+    "Payload bytes written per compiled-graph channel edge",
+    component="cgraph",
+    tag_keys=("channel",),
+)
+CGRAPH_RING_HWM = Gauge(
+    "raytpu_cgraph_ring_occupancy_hwm_bytes",
+    "High-water mark of ring-buffer occupancy per compiled-graph channel",
+    component="cgraph",
+    tag_keys=("channel",),
+)
+CGRAPH_EXECUTE_LATENCY = Histogram(
+    "raytpu_cgraph_execute_latency_ms",
+    "End-to-end latency of one compiled-graph iteration (execute to fetch)",
+    component="cgraph",
+    tag_keys=("graph",),
+)
+CGRAPH_EXECUTIONS = Counter(
+    "raytpu_cgraph_executions_total",
+    "Compiled-graph iterations driven, per graph",
+    component="cgraph",
+    tag_keys=("graph",),
+)
 # --- per-node reporter agent ---------------------------------------------
 NODE_CPU_PERCENT = Gauge(
     "raytpu_node_cpu_percent",

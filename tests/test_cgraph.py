@@ -1,0 +1,435 @@
+"""Compiled graphs: the cgraph channel data plane + collective edges
+(reference: python/ray/dag/compiled_dag_node.py experimental_compile /
+execute / CompiledDAGRef; ray.experimental.collective allreduce.bind)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import cgraph
+from ray_tpu.core.channel import (
+    ChannelClosed,
+    ChannelReader,
+    ChannelSpec,
+    ChannelWriter,
+    required_capacity,
+)
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+# Module-scoped: one cluster serves every test here (each test creates
+# its own actors; compiled graphs tear down per test). Keeps the suite's
+# wall-clock bounded — a per-test cluster spawn would dominate runtime.
+@pytest.fixture(scope="module")
+def rt_cluster():
+    rt.shutdown()
+    rt.init(num_cpus=8, num_workers=3)
+    yield rt
+    rt.shutdown()
+
+
+@rt.remote
+class Stage:
+    def __init__(self, k):
+        self.k = k
+
+    def add(self, x):
+        return x + self.k
+
+    def mul2(self, x):
+        return x * 2
+
+    def shard(self, x):
+        return np.full(16, float(x + self.k))
+
+    def first(self, arr):
+        return float(np.asarray(arr).reshape(-1)[0])
+
+
+# ------------------------------------------------------------- correctness
+def test_compile_matches_eager(rt_cluster):
+    """Compiled execution must produce exactly what the eager (per-submit)
+    DAG produces, across repeated stateless iterations."""
+    s1, s2, s3 = Stage.remote(1), Stage.remote(10), Stage.remote(100)
+    with InputNode() as inp:
+        dag = s3.add.bind(s2.add.bind(s1.add.bind(inp)))
+    eager = [rt.get(dag.execute(i), timeout=60) for i in range(5)]
+    compiled = cgraph.compile(dag)
+    try:
+        got = [compiled.execute(i).get(timeout=30) for i in range(5)]
+        assert got == eager == [111 + i for i in range(5)]
+    finally:
+        compiled.teardown()
+
+
+def test_multi_output_and_diamond(rt_cluster):
+    """A diamond (one producer fanning out to two consumers) with a
+    MultiOutputNode root."""
+    src, l, r = Stage.remote(1), Stage.remote(0), Stage.remote(0)
+    with InputNode() as inp:
+        mid = src.add.bind(inp)
+        dag = MultiOutputNode([l.mul2.bind(mid), r.add.bind(mid)])
+    compiled = cgraph.compile(dag)
+    try:
+        for i in range(4):
+            assert compiled.execute(i).get(timeout=30) == [(i + 1) * 2, i + 1]
+    finally:
+        compiled.teardown()
+
+
+def test_200_iterations_bounded_and_clean_teardown(rt_cluster):
+    """Acceptance: 200 consecutive execute() calls reuse the same rings
+    (no per-iteration channel allocation — the executor's reader/writer
+    sets are fixed at compile time) and teardown is clean."""
+    s1, s2 = Stage.remote(2), Stage.remote(5)
+    with InputNode() as inp:
+        dag = s2.add.bind(s1.add.bind(inp))
+    compiled = cgraph.compile(dag, max_inflight=8)
+    try:
+        refs = []
+        for i in range(200):
+            refs.append(compiled.execute(i))
+            # Driver buffer stays bounded when results are consumed.
+            if len(refs) >= 16:
+                assert refs.pop(0).get(timeout=30) == (i - 15) + 7
+        for j, ref in enumerate(refs):
+            assert ref.get(timeout=30) == (200 - len(refs) + j) + 7
+        assert compiled.inflight == 0
+    finally:
+        compiled.teardown()
+    # Idempotent + post-teardown execute fails loudly.
+    compiled.teardown()
+    with pytest.raises(RuntimeError, match="torn down"):
+        compiled.execute(0)
+
+
+# ------------------------------------------------------------ backpressure
+def test_max_inflight_backpressure(rt_cluster):
+    """The driver never lets more than max_inflight iterations live in
+    the channels; excess execute() calls first reclaim a completed round
+    into the driver buffer."""
+    s = Stage.remote(1)
+    with InputNode() as inp:
+        dag = s.add.bind(inp)
+    compiled = cgraph.compile(dag, max_inflight=2)
+    try:
+        refs = [compiled.execute(i) for i in range(12)]
+        assert compiled.inflight <= 2
+        assert [r.get(timeout=30) for r in refs] == [i + 1 for i in range(12)]
+    finally:
+        compiled.teardown()
+
+
+def test_max_inflight_validation(rt_cluster):
+    s = Stage.remote(1)
+    with InputNode() as inp:
+        dag = s.add.bind(inp)
+    with pytest.raises(ValueError, match="max_inflight"):
+        cgraph.compile(dag, max_inflight=0)
+
+
+# -------------------------------------------------------- collective edges
+def test_allreduce_edge_matches_collective(rt_cluster):
+    """A compiled allreduce edge must equal collective.allreduce over the
+    same member arrays (it IS the same transport, bound at compile time)."""
+    ws = [Stage.remote(1), Stage.remote(2)]
+    with InputNode() as inp:
+        shards = [w.shard.bind(inp) for w in ws]
+        reduced = cgraph.allreduce.bind(shards)
+        dag = MultiOutputNode([w.first.bind(r) for w, r in zip(ws, reduced)])
+    compiled = cgraph.compile(dag)
+    try:
+        for i in range(3):
+            out = compiled.execute(i).get(timeout=60)
+            # member arrays: full(16, i+1) and full(16, i+2) -> sum everywhere
+            expected = (i + 1.0) + (i + 2.0)
+            assert out == [expected, expected]
+    finally:
+        compiled.teardown()
+
+
+def test_reduce_scatter_edge(rt_cluster):
+    ws = [Stage.remote(1), Stage.remote(2)]
+    with InputNode() as inp:
+        shards = [w.shard.bind(inp) for w in ws]
+        reduced = cgraph.reduce_scatter.bind(shards)
+        dag = MultiOutputNode([w.first.bind(r) for w, r in zip(ws, reduced)])
+    compiled = cgraph.compile(dag)
+    try:
+        out = compiled.execute(0).get(timeout=60)
+        # Each member holds a fully-reduced slice: 1.0 + 2.0 everywhere.
+        assert out == [3.0, 3.0]
+    finally:
+        compiled.teardown()
+
+
+def test_p2p_edge(rt_cluster):
+    """p2p.bind moves the value over a dedicated 2-member communicator;
+    the receiving actor consumes it like any local upstream."""
+    a, b = Stage.remote(10), Stage.remote(100)
+    with InputNode() as inp:
+        moved = cgraph.p2p.bind(a.shard.bind(inp), b)
+        dag = b.first.bind(moved)
+    compiled = cgraph.compile(dag)
+    try:
+        for i in range(3):
+            assert compiled.execute(i).get(timeout=60) == float(i + 10)
+    finally:
+        compiled.teardown()
+
+
+def test_gang_survives_member_error(rt_cluster):
+    """One member's upstream failure must NOT wedge the gang: the status
+    lap keeps every member in lockstep, the error surfaces at the driver,
+    and the next iteration still works."""
+
+    @rt.remote
+    class Flaky:
+        def __init__(self, k):
+            self.k = k
+
+        def shard(self, x):
+            if self.k == 1 and x == 3:
+                raise ValueError("shard three")
+            return np.full(4, float(x + self.k))
+
+        def first(self, arr):
+            return float(np.asarray(arr).reshape(-1)[0])
+
+    ws = [Flaky.remote(1), Flaky.remote(2)]
+    with InputNode() as inp:
+        shards = [w.shard.bind(inp) for w in ws]
+        reduced = cgraph.allreduce.bind(shards)
+        dag = MultiOutputNode([w.first.bind(r) for w, r in zip(ws, reduced)])
+    compiled = cgraph.compile(dag)
+    try:
+        assert compiled.execute(0).get(timeout=60) == [3.0, 3.0]
+        with pytest.raises((ValueError, RuntimeError)):
+            compiled.execute(3).get(timeout=60)  # not a hang
+        assert compiled.execute(5).get(timeout=60) == [13.0, 13.0]
+    finally:
+        compiled.teardown()
+
+
+def test_p2p_from_input_rejected(rt_cluster):
+    a = Stage.remote(1)
+    with InputNode() as inp:
+        moved = cgraph.p2p.bind(inp, a)
+        dag = a.first.bind(moved)
+    with pytest.raises(ValueError, match="actor-resident"):
+        cgraph.compile(dag)
+
+
+def test_partial_gang_rejected(rt_cluster):
+    """Dropping one allreduce output from the graph would deadlock the
+    other members at the collective — the compiler must reject it."""
+    ws = [Stage.remote(1), Stage.remote(2)]
+    with InputNode() as inp:
+        shards = [w.shard.bind(inp) for w in ws]
+        reduced = cgraph.allreduce.bind(shards)
+        dag = ws[0].first.bind(reduced[0])  # reduced[1] unreachable
+    with pytest.raises(ValueError, match="partially bound"):
+        cgraph.compile(dag)
+
+
+def test_collective_node_has_no_eager_form(rt_cluster):
+    ws = [Stage.remote(1), Stage.remote(2)]
+    with InputNode() as inp:
+        shards = [w.shard.bind(inp) for w in ws]
+        reduced = cgraph.allreduce.bind(shards)
+        dag = MultiOutputNode(reduced)
+    with pytest.raises(TypeError, match="compiled graph"):
+        dag.execute(1)
+
+
+# ----------------------------------------------------------- failure paths
+def test_actor_death_surfaces_channel_closed(rt_cluster):
+    s1, s2 = Stage.remote(1), Stage.remote(10)
+    with InputNode() as inp:
+        dag = s2.add.bind(s1.add.bind(inp))
+    compiled = cgraph.compile(dag)
+    try:
+        assert compiled.execute(0).get(timeout=30) == 11
+        rt.kill(s1)
+        time.sleep(0.5)
+        with pytest.raises(ChannelClosed):
+            # The write may still land in the dead actor's ring; the
+            # cascade then surfaces on fetch. Either call may raise.
+            compiled.execute(1).get(timeout=15)
+        # Once broken, the graph refuses new work instead of hanging.
+        with pytest.raises((ChannelClosed, RuntimeError)):
+            compiled.execute(2)
+    finally:
+        compiled.teardown()  # clean teardown after death
+
+
+def test_node_error_propagates_and_pipeline_survives(rt_cluster):
+    @rt.remote
+    class Boomer:
+        def go(self, x):
+            if x == 3:
+                raise ValueError("x was three")
+            return x * 2
+
+    a = Boomer.remote()
+    with InputNode() as inp:
+        dag = a.go.bind(inp)
+    compiled = cgraph.compile(dag)
+    try:
+        assert compiled.execute(2).get(timeout=30) == 4
+        with pytest.raises(ValueError, match="x was three"):
+            compiled.execute(3).get(timeout=30)
+        assert compiled.execute(4).get(timeout=30) == 8  # survives the error
+    finally:
+        compiled.teardown()
+
+
+# ----------------------------------------------------------- plan checking
+def test_plain_function_nodes_rejected(rt_cluster):
+    @rt.remote
+    def f(x):
+        return x
+
+    with InputNode() as inp:
+        dag = f.bind(inp)
+    with pytest.raises(ValueError, match="actor method"):
+        cgraph.compile(dag)
+
+
+def test_ungated_node_rejected(rt_cluster):
+    s = Stage.remote(1)
+    with InputNode() as inp:  # noqa: F841 (graph deliberately ignores it)
+        dag = s.add.bind(7)
+    with pytest.raises(ValueError, match="gated"):
+        cgraph.compile(dag)
+
+
+# --------------------------------------------------- channel layer (unit)
+def test_writer_close_wakes_blocked_reader(tmp_path):
+    """Satellite: writer close() while the reader blocks in read() must
+    raise ChannelClosed promptly — no hang, bounded poll."""
+    import threading
+
+    r = ChannelReader(str(tmp_path), capacity=1 << 16)
+    w = ChannelWriter(r.spec())
+    w.write("warm")
+    assert r.read(timeout=5) == "warm"
+
+    got = {}
+
+    def blocked_read():
+        t0 = time.monotonic()
+        try:
+            r.read(timeout=30)
+        except ChannelClosed:
+            got["latency"] = time.monotonic() - t0
+
+    t = threading.Thread(target=blocked_read)
+    t.start()
+    time.sleep(0.3)  # let the reader block in its poll loop
+    w.close()
+    t.join(timeout=10)
+    assert not t.is_alive(), "reader still blocked after writer close()"
+    assert got["latency"] < 5.0, f"ChannelClosed took {got['latency']:.1f}s"
+    r.close()
+
+
+def test_reader_close_unblocks_writer_backpressure(tmp_path):
+    """The mirror direction: a writer blocked on a full ring must see
+    ChannelClosed when the reader closes."""
+    import threading
+
+    r = ChannelReader(str(tmp_path), capacity=1 << 10)
+    w = ChannelWriter(r.spec())
+    payload = b"x" * 300  # ~3 records fill the 1 KiB ring
+
+    def fill_then_block():
+        try:
+            for _ in range(100):
+                w.write_bytes(payload, timeout=30)
+        except ChannelClosed:
+            return
+
+    t = threading.Thread(target=fill_then_block)
+    t.start()
+    time.sleep(0.3)
+    r.close()
+    t.join(timeout=10)
+    assert not t.is_alive(), "writer still blocked after reader close()"
+    w.close()
+
+
+def test_channel_spec_validates_capacity(tmp_path):
+    with pytest.raises(ValueError, match="capacity"):
+        ChannelSpec("x", "/tmp/r", "/tmp/s", ("127.0.0.1", 1), 0)
+    with pytest.raises(TypeError):
+        ChannelSpec("x", "/tmp/r", "/tmp/s", ("127.0.0.1", 1), "big")
+    # A reader declaring its max message gets the aligned-fit check.
+    with pytest.raises(ValueError, match="aligned"):
+        ChannelReader(str(tmp_path), capacity=1 << 10, max_message=1 << 10)
+    assert required_capacity(0) >= 64
+    r = ChannelReader(str(tmp_path), capacity=required_capacity(256), max_message=256)
+    r.close()
+
+
+def test_compile_rejects_undersized_buffer(rt_cluster):
+    s = Stage.remote(1)
+    with InputNode() as inp:
+        dag = s.add.bind(inp)
+    with pytest.raises(ValueError, match="aligned"):
+        cgraph.compile(dag, buffer_size_bytes=1 << 12, max_message_bytes=1 << 12)
+
+
+# ----------------------------------------------------------------- metrics
+def test_cgraph_metrics_flow_to_state_api(rt_cluster):
+    """The data plane's instrumentation reaches the cluster-aggregated
+    internal-metrics table (what `ray-tpu metrics` and
+    /api/internal_metrics render)."""
+    from ray_tpu.utils import state
+
+    def msgs_rows():
+        return [
+            m
+            for m in state.internal_metrics()
+            if m["name"] == "raytpu_cgraph_channel_msgs_total"
+        ]
+
+    base = sum(m["value"] for m in msgs_rows())
+    s1, s2 = Stage.remote(1), Stage.remote(10)
+    with InputNode() as inp:
+        dag = s2.add.bind(s1.add.bind(inp))
+    compiled = cgraph.compile(dag)
+    try:
+        for i in range(20):
+            assert compiled.execute(i).get(timeout=30) == i + 11
+        want = {
+            "raytpu_cgraph_channel_msgs_total",
+            "raytpu_cgraph_channel_bytes_total",
+            "raytpu_cgraph_ring_occupancy_hwm_bytes",
+            "raytpu_cgraph_execute_latency_ms",
+        }
+        # Poll for the COUNT DELTA, not just the metric names: earlier
+        # tests (or a prior cluster's stranded flush backlog) may have
+        # seeded the table — only this graph's 20 iterations prove the
+        # new data plane reports.
+        deadline = time.monotonic() + 30
+        names, msgs = set(), []
+        while time.monotonic() < deadline:
+            recs = state.internal_metrics()
+            names = {m["name"] for m in recs}
+            msgs = [
+                m for m in recs if m["name"] == "raytpu_cgraph_channel_msgs_total"
+            ]
+            if want <= names and sum(m["value"] for m in msgs) - base >= 20:
+                break
+            time.sleep(0.5)  # flusher interval is ~1 s
+        assert want <= names
+        # Every record is per-channel tagged and counted something.
+        assert msgs and all(m["tags"].get("channel") for m in msgs)
+        # 20 iterations crossed at least the driver input edge plus the
+        # inter-stage and output edges.
+        assert sum(m["value"] for m in msgs) - base >= 20
+    finally:
+        compiled.teardown()

@@ -9,7 +9,9 @@ import pytest
 import ray_tpu as rt
 
 
-@pytest.fixture
+# Module-scoped: one cluster serves every test (each test forms its own
+# uniquely-named group on fresh actors and leaves it before exiting).
+@pytest.fixture(scope="module")
 def rt_cluster():
     rt.shutdown()
     rt.init(num_cpus=4, num_workers=3)
@@ -76,6 +78,77 @@ def test_two_process_collective_group(rt_cluster):
         rt.get([m.do_barrier_then_rank.remote("g2") for m in members], timeout=120)
     ) == [0, 1]
     rt.get([m.leave.remote("g2") for m in members], timeout=60)
+
+
+def test_group_reinit_same_name_after_restart(rt_cluster):
+    """Regression: re-init of the same group name WITHOUT a prior destroy
+    (the actor-restart path) must not deadlock. The old teardown order
+    destroyed the previous membership AFTER the new one registered,
+    deleting the fresh rank key out from under the peers' rendezvous."""
+    members = [Member.remote() for _ in range(2)]
+    rt.get(
+        [m.join.remote(2, i, "gre") for i, m in enumerate(members)], timeout=120
+    )
+    outs = rt.get([m.do_allreduce.remote("gre") for m in members], timeout=120)
+    for o in outs:
+        np.testing.assert_allclose(o, np.full(1000, 3.0))
+    # Simulated restart: join again with the same name, no leave.
+    rt.get(
+        [m.join.remote(2, i, "gre") for i, m in enumerate(members)], timeout=120
+    )
+    outs = rt.get([m.do_allreduce.remote("gre") for m in members], timeout=120)
+    for o in outs:
+        np.testing.assert_allclose(o, np.full(1000, 3.0))
+    rt.get([m.leave.remote("gre") for m in members], timeout=60)
+
+
+def test_destroy_then_reinit_same_name(rt_cluster):
+    """Clean leave deregisters from the GCS, so a later same-name group
+    rendezvouses from scratch."""
+    from ray_tpu.collective import _KV_PREFIX
+    from ray_tpu.core import runtime_base
+
+    members = [Member.remote() for _ in range(2)]
+    rt.get(
+        [m.join.remote(2, i, "gdr") for i, m in enumerate(members)], timeout=120
+    )
+    rt.get([m.leave.remote("gdr") for m in members], timeout=60)
+    gcs = runtime_base.current_runtime()._gcs
+    assert gcs.call("kv_keys", f"{_KV_PREFIX}gdr/") == []  # deregistered
+    rt.get(
+        [m.join.remote(2, i, "gdr") for i, m in enumerate(members)], timeout=120
+    )
+    outs = rt.get([m.do_allreduce.remote("gdr") for m in members], timeout=120)
+    for o in outs:
+        np.testing.assert_allclose(o, np.full(1000, 3.0))
+    rt.get([m.leave.remote("gdr") for m in members], timeout=60)
+
+
+def test_stale_registration_does_not_wedge_rendezvous(rt_cluster):
+    """Regression: a stale rank->addr key left by a crashed member (no
+    destroy) must not wedge the next rendezvous — the connect loop
+    re-resolves the neighbor every retry, picking up the fresh
+    registration the moment it overwrites the stale one."""
+    import time as _time
+
+    from ray_tpu.collective import _KV_PREFIX
+    from ray_tpu.core import runtime_base
+
+    gcs = runtime_base.current_runtime()._gcs
+    # Dead addresses for both ranks (a port nothing listens on).
+    for rank in (0, 1):
+        gcs.call("kv_put", f"{_KV_PREFIX}gst/{rank}", b"127.0.0.1:9")
+    members = [Member.remote() for _ in range(2)]
+    t0 = _time.monotonic()
+    rt.get(
+        [m.join.remote(2, i, "gst") for i, m in enumerate(members)], timeout=120
+    )
+    # Well under the 60 s ring deadline: the fresh put is seen promptly.
+    assert _time.monotonic() - t0 < 45.0
+    outs = rt.get([m.do_allreduce.remote("gst") for m in members], timeout=120)
+    for o in outs:
+        np.testing.assert_allclose(o, np.full(1000, 3.0))
+    rt.get([m.leave.remote("gst") for m in members], timeout=60)
 
 
 def test_three_process_ring_allreduce_and_allgather(rt_cluster):

@@ -7,7 +7,9 @@ import ray_tpu as rt
 from ray_tpu.dag import InputNode, MultiOutputNode
 
 
-@pytest.fixture
+# Module-scoped: one cluster serves every test (each creates its own
+# actors/graphs; compiled graphs tear down per test).
+@pytest.fixture(scope="module")
 def rt_cluster():
     rt.shutdown()
     rt.init(num_cpus=4, num_workers=2)

@@ -39,6 +39,21 @@ SKIP = {
     "ray_tpu.native.build",
 }
 
+# Subsystems the walk MUST cover: a packaging slip that hides one of these
+# (missing __init__, renamed dir) would silently shrink the check to
+# nothing for that layer. The compiled-graph data plane is listed
+# explicitly — its modules run inside every participating actor, so an
+# import-time backend init there would wedge whole gangs at compile time.
+REQUIRED = {
+    "ray_tpu.cgraph",
+    "ray_tpu.cgraph.compile",
+    "ray_tpu.cgraph.communicator",
+    "ray_tpu.cgraph.executor",
+    "ray_tpu.cgraph.plan",
+    "ray_tpu.core.channel",
+    "ray_tpu.collective",
+}
+
 
 def iter_module_names() -> list:
     import ray_tpu
@@ -56,8 +71,13 @@ def check() -> int:
         "run me via main() — the canary platform must be set before "
         "any jax import"
     )
+    names = iter_module_names()
+    missing = REQUIRED - set(names)
+    if missing:
+        print(f"coverage hole: required modules not discovered: {sorted(missing)}")
+        return 3
     failed = []
-    for name in iter_module_names():
+    for name in names:
         try:
             importlib.import_module(name)
         except Exception as e:  # noqa: BLE001
