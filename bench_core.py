@@ -209,6 +209,74 @@ def bench_overhead_guard(min_time: float) -> None:
     )
 
 
+def bench_tracing_overhead_guard(min_time: float) -> None:
+    """Tracing/flight-recorder overhead guard (three cluster boots, env
+    read at daemon spawn):
+
+    - `off`:    RAY_TPU_TRACING=0 + flight recorder off (floor),
+    - `flight`: tracing off, flight recorder on — the SHIPPED default;
+      must cost <2% of the floor (the always-on ring's budget),
+    - `on`:     RAY_TPU_TRACING=1 + flight recorder on (informational —
+      tracing is opt-in and pays JSONL writes by design).
+    """
+    import os
+    import shutil
+    import tempfile
+
+    from ray_tpu.observability import flight_recorder as frec
+
+    trace_dir = tempfile.mkdtemp(prefix="bench_traces_")
+    configs = (
+        ("off", "0", "0"),
+        ("flight", "0", "1"),
+        ("on", "1", "1"),
+    )
+    env_keys = ("RAY_TPU_TRACING", "RAY_TPU_FLIGHT_RECORDER", "RAY_TPU_TRACE_DIR")
+    saved_env = {k: os.environ.get(k) for k in env_keys}
+    saved_enabled = frec.RECORDER._enabled
+    rates = {}
+    try:
+        for label, tracing_flag, flight_flag in configs:
+            os.environ["RAY_TPU_TRACING"] = tracing_flag
+            os.environ["RAY_TPU_FLIGHT_RECORDER"] = flight_flag
+            os.environ["RAY_TPU_TRACE_DIR"] = trace_dir
+            frec.RECORDER._enabled = flight_flag == "1"  # driver-side follows
+            rt.init(num_cpus=8, num_workers=2, object_store_memory=256 << 20)
+            rates[label] = _sync_dispatch_rate(min_time)
+            rt.shutdown()
+    finally:
+        # Restore the operator's configuration, not a hardcoded default.
+        for key, val in saved_env.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        frec.RECORDER._enabled = saved_enabled
+        shutil.rmtree(trace_dir, ignore_errors=True)
+    disabled_ratio = rates["flight"] / rates["off"] if rates["off"] else 0.0
+    traced_ratio = rates["on"] / rates["off"] if rates["off"] else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "tracing_overhead",
+                "value": round(disabled_ratio, 3),
+                "unit": "x (flight-recorder-on/off sync dispatch; tracing disabled)",
+                "vs_baseline": None,
+                "traced_ratio": round(traced_ratio, 3),
+                "off_ops_s": round(rates["off"], 1),
+                "flight_ops_s": round(rates["flight"], 1),
+                "traced_ops_s": round(rates["on"], 1),
+            }
+        ),
+        flush=True,
+    )
+    assert disabled_ratio >= 0.98, (
+        f"disabled-mode tracing (flight recorder only) cost "
+        f"{100 * (1 - disabled_ratio):.1f}% of no-op dispatch (budget: 2%) "
+        f"— {rates}"
+    )
+
+
 def _store_puts_total() -> float:
     """Cluster-aggregated raytpu_store_puts_total (all processes)."""
     from ray_tpu.utils import state
@@ -463,6 +531,7 @@ def main():
     print(json.dumps(summary), flush=True)
     # Last: a guard failure must not discard the completed run's results.
     bench_overhead_guard(min_time)
+    bench_tracing_overhead_guard(min_time)
 
 
 if __name__ == "__main__":

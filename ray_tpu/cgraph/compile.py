@@ -17,13 +17,16 @@ channels (backpressure against an unbounded producer).
 
 from __future__ import annotations
 
+import contextlib
 import tempfile
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import api
+from .. import tracing as _tracing
 from ..core.channel import ChannelClosed, ChannelReader, ChannelWriter
+from ..observability import flight_recorder as _frec
 from ..dag import DAGNode
 from ..utils import internal_metrics as imet
 from .communicator import TpuCommunicator
@@ -92,6 +95,18 @@ class CompiledGraph:
         # compiles should reuse graphs, not recompile per iteration.
         self._m_latency = imet.CGRAPH_EXECUTE_LATENCY.labels(graph=self._dag_id[:8])
         self._m_execs = imet.CGRAPH_EXECUTIONS.labels(graph=self._dag_id[:8])
+        # Graph trace identity: the exec loops are resident threads — no
+        # per-iteration task entry carries a trace_ctx — so the ONE
+        # context minted at compile time rides the wire plan instead, and
+        # every process's iteration spans share this trace_id. Flow ids
+        # are derived per iteration (`cg:<dag>:<seq>`) on both sides.
+        self._trace_ctx: Optional[dict] = None
+        if _tracing.is_enabled():
+            ctx = _tracing.current_context()
+            self._trace_ctx = {
+                "trace_id": ctx["trace_id"] if ctx else uuid.uuid4().hex,
+                "span_id": ctx["span_id"] if ctx else None,
+            }
 
         # ---- wire up: setup (actors host in-edge readers) -> driver
         # readers -> communicators -> start (actors attach writers + loops)
@@ -103,9 +118,12 @@ class CompiledGraph:
         set_up: List[Any] = []  # actors whose contexts need undo on failure
         try:
             for a, h in self._handles.items():
+                actor_plan = self._plan.actor_plans[a]
+                if self._trace_ctx is not None:
+                    actor_plan = dict(actor_plan, trace_ctx=self._trace_ctx)
                 ref = h._invoke(
                     "__ray_dag_setup__",
-                    (self._dag_id, self._plan.actor_plans[a]),
+                    (self._dag_id, actor_plan),
                     {},
                     1,
                 )
@@ -180,6 +198,31 @@ class CompiledGraph:
         by_input = {
             n._id: v for n, v in zip(self._plan.inputs, input_values)
         }
+        span_cm = (
+            _tracing.continue_context(
+                self._trace_ctx,
+                f"cgraph.execute {self._dag_id[:8]}",
+                {
+                    "dag": self._dag_id[:8],
+                    "seq": self._seq,
+                    # Tail of the per-iteration flow chain; the actors'
+                    # iteration spans step it, the driver's round read
+                    # ends it.
+                    "flow_out": f"cg:{self._dag_id[:8]}:{self._seq}",
+                },
+            )
+            if self._trace_ctx is not None and _tracing.is_enabled()
+            else contextlib.nullcontext()
+        )
+        with span_cm:
+            self._write_inputs(by_input)
+        ref = CompiledRef(self, self._seq)
+        self._t0[self._seq] = time.perf_counter()
+        self._m_execs.inc()
+        self._seq += 1
+        return ref
+
+    def _write_inputs(self, by_input: Dict[int, Any]) -> None:
         for i, (input_nid, w) in enumerate(self._in_writers):
             try:
                 w.write(by_input[input_nid], timeout=60.0)
@@ -202,11 +245,6 @@ class CompiledGraph:
                         "been torn down — recompile the DAG"
                     )
                 raise
-        ref = CompiledRef(self, self._seq)
-        self._t0[self._seq] = time.perf_counter()
-        self._m_execs.inc()
-        self._seq += 1
-        return ref
 
     def _read_round(self, timeout: Optional[float]) -> None:
         """Drains one full output round (one value per output channel)
@@ -216,10 +254,56 @@ class CompiledGraph:
         # or a retried get() would pair channel A's iteration k+1 with
         # channel B's iteration k forever after.
         vals = self._partial_round
+        seq = self._next_read
+        span_cm = (
+            _tracing.continue_context(
+                self._trace_ctx,
+                f"cgraph.round {self._dag_id[:8]}",
+                {
+                    "dag": self._dag_id[:8],
+                    "seq": seq,
+                    # Head of the iteration's flow chain (tail at
+                    # execute(), steps at each actor's iteration span).
+                    "flow_in": f"cg:{self._dag_id[:8]}:{seq}",
+                },
+            )
+            if self._trace_ctx is not None and _tracing.is_enabled()
+            else contextlib.nullcontext()
+        )
         try:
-            for nid, r in self._out_readers:
-                if nid not in vals:
-                    vals[nid] = r.read(timeout=timeout)  # None blocks
+            with span_cm:
+                for nid, r in self._out_readers:
+                    if nid not in vals:
+                        vals[nid] = r.read(timeout=timeout)  # None blocks
+        except TimeoutError:
+            # A stuck execute is exactly what the flight recorder exists
+            # for: dump the ring NOW, naming the blocked channel, so the
+            # hang is post-mortem-able even if the caller just retries.
+            blocked = next(
+                (
+                    self._plan.edge_label(self._plan.out_edge_ids[nid])
+                    for nid, _r in self._out_readers
+                    if nid not in vals
+                ),
+                "?",
+            )
+            dump_path = _frec.dump(
+                reason=(
+                    f"cgraph execute timeout: dag {self._dag_id[:8]} seq "
+                    f"{seq} blocked on output channel {blocked}"
+                ),
+                extra={"dag": self._dag_id, "seq": seq, "blocked_channel": blocked},
+            )
+            dump_note = (
+                f"; flight-recorder dump written to {dump_path}"
+                if dump_path
+                else ""
+            )
+            raise TimeoutError(
+                f"compiled graph {self._dag_id[:8]}: execute() result for "
+                f"seq {seq} not ready after {timeout}s (blocked on channel "
+                f"{blocked}{dump_note})"
+            )
         except ChannelClosed:
             broken = (
                 f"compiled graph {self._dag_id[:8]}: output channel closed "

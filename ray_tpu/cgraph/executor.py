@@ -19,12 +19,15 @@ record (see cgraph/communicator.py).
 
 from __future__ import annotations
 
+import contextlib
 import tempfile
 import threading
 import traceback
 from typing import Any, Dict
 
+from .. import tracing as _tracing
 from ..core.channel import ChannelClosed, ChannelReader, ChannelWriter
+from ..observability import flight_recorder as _frec
 
 
 class DagError:
@@ -145,37 +148,35 @@ class GraphExecutor:
         reads. All channels are FIFO, so iteration k's values line up
         across the whole DAG without sequence numbers."""
         nodes = self.plan["nodes"]
+        dag8 = self.plan["dag_id"][:8]
+        trace_ctx = self.plan.get("trace_ctx")
+        seq = 0
         while not self.stop.is_set():
-            vals: Dict[int, Any] = {}
+            # Iteration span (channel-wait / compute / collective
+            # sub-spans inside _iterate), sharing the graph's compile-time
+            # trace_id and stepping the per-iteration flow chain. Tracing
+            # off = one None check per iteration.
+            traced = trace_ctx is not None and _tracing.is_enabled()
+            iter_cm = (
+                _tracing.continue_context(
+                    trace_ctx,
+                    f"cgraph.iter {dag8}",
+                    {"dag": dag8, "seq": seq, "flow_step": f"cg:{dag8}:{seq}"},
+                )
+                if traced
+                else contextlib.nullcontext()
+            )
             try:
-                for node in nodes:
-                    for r in node["reads"]:
-                        vals[r["src_node"]] = self.readers[r["edge_id"]].read()
-                    out = self._run_node(node, vals)
-                    vals[node["node_id"]] = out
-                    for cs in node.get("coll_sends", ()):
-                        self._coll_send(cs, out)
-                    for eid in node["writes"]:
-                        try:
-                            self.writers[eid].write(out)
-                        except (ChannelClosed, OSError):
-                            raise
-                        except Exception as e:  # noqa: BLE001
-                            # Oversize record / unpicklable result: the
-                            # execution must still produce SOMETHING on
-                            # this edge or the whole DAG wedges — forward
-                            # a DagError instead (it is small and
-                            # picklable).
-                            self.writers[eid].write(
-                                DagError(e, node.get("desc", ""), traceback.format_exc())
-                            )
+                with iter_cm:
+                    self._iterate(nodes, traced)
             except (ChannelClosed, OSError):
                 break  # teardown raced a blocked read/write
             except Exception:  # noqa: BLE001
                 # Unexpected framework-side failure (malformed plan, pickle
                 # bug, ...): the cascade below surfaces only ChannelClosed
                 # to the driver, so record the real cause where an operator
-                # can find it before breaking.
+                # can find it before breaking — and dump the flight ring:
+                # the last recorded events name the node/channel involved.
                 import sys
 
                 print(
@@ -184,12 +185,55 @@ class GraphExecutor:
                     file=sys.stderr,
                     flush=True,
                 )
+                _frec.dump(
+                    reason=f"cgraph exec loop crash (dag {dag8}, seq {seq})"
+                )
                 break
+            seq += 1
         # Cascade the shutdown: whatever ended this loop (teardown, a dead
         # upstream actor, a severed collective ring), downstream consumers
         # and ultimately the driver must observe ChannelClosed instead of
         # blocking forever on edges this actor will never write again.
         self.teardown()
+
+    def _iterate(self, nodes, traced: bool) -> None:
+        """One DAG iteration; sub-spans split the time into channel-wait
+        vs compute vs collective when tracing is on."""
+        span = _tracing.span if traced else _tracing.null_span
+        vals: Dict[int, Any] = {}
+        for node in nodes:
+            if node["reads"]:
+                with span(
+                    "cgraph.channel_wait", {"node": node.get("desc", "")}
+                ):
+                    for r in node["reads"]:
+                        vals[r["src_node"]] = self.readers[r["edge_id"]].read()
+            _frec.record("cgraph.node", node.get("desc") or node.get("method"))
+            kind = (
+                f"cgraph.collective {node['collective']['kind']}"
+                if node.get("collective")
+                else f"cgraph.compute {node.get('method', '?')}"
+            )
+            with span(kind, {"node": node.get("desc", "")}):
+                out = self._run_node(node, vals)
+            vals[node["node_id"]] = out
+            for cs in node.get("coll_sends", ()):
+                with span("cgraph.collective send", {"dst_rank": cs["dst_rank"]}):
+                    self._coll_send(cs, out)
+            for eid in node["writes"]:
+                try:
+                    self.writers[eid].write(out)
+                except (ChannelClosed, OSError):
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    # Oversize record / unpicklable result: the
+                    # execution must still produce SOMETHING on
+                    # this edge or the whole DAG wedges — forward
+                    # a DagError instead (it is small and
+                    # picklable).
+                    self.writers[eid].write(
+                        DagError(e, node.get("desc", ""), traceback.format_exc())
+                    )
 
     def _coll_send(self, cs: dict, out: Any) -> None:
         from .. import collective

@@ -34,6 +34,9 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from . import tracing as _tracing
+from .observability.flight_recorder import record as _flight_record
+
 _LEN = struct.Struct("<Q")
 _KV_PREFIX = "__collective__/"
 
@@ -110,7 +113,9 @@ class _Group:
         self._prev: Optional[socket.socket] = None  # from (rank-1) % ws
         self._lock = threading.Lock()
         if world_size > 1:
+            _flight_record("coll.rendezvous", (name, rank, world_size))
             self._establish_ring()
+            _flight_record("coll.ring_up", (name, rank))
 
     def _lookup(self, rank: int, timeout: float = 60.0) -> tuple:
         deadline = time.monotonic() + timeout
@@ -299,6 +304,7 @@ class _Group:
         rendezvous. Guarded delete: a successor group under the same
         (name, rank) may already have registered — deleting ITS key would
         strand its peers' lookups (the re-init deadlock this fixes)."""
+        _flight_record("coll.destroy", (self.name, self.rank))
         key = f"{_KV_PREFIX}{self.name}/{self.rank}"
         try:
             cur = self._gcs.call("kv_get", key)
@@ -357,32 +363,63 @@ def _group(name: str) -> _Group:
     return g
 
 
+def _op_span(kind: str, group: "_Group", **attrs):
+    """Span + flight-record bracket around one collective op. The flight
+    record is unconditional (a hang dump's last `coll.op` names the op
+    and group a gang member was stuck in); the span is tracing-gated and
+    carries rank/world for the timeline."""
+    _flight_record("coll.op", (kind, group.name, group.rank))
+    return _tracing.maybe_span(
+        f"collective.{kind}",
+        {
+            "group": group.name,
+            "rank": group.rank,
+            "world_size": group.world_size,
+            **attrs,
+        },
+    )
+
+
 def allreduce(arr, group_name: str = "default", op: str = "sum"):
-    return _group(group_name).allreduce(np.asarray(arr), op)
+    g = _group(group_name)
+    with _op_span("allreduce", g, op=op):
+        return g.allreduce(np.asarray(arr), op)
 
 
 def broadcast(arr, src_rank: int = 0, group_name: str = "default"):
-    return _group(group_name).broadcast(arr, src_rank)
+    g = _group(group_name)
+    with _op_span("broadcast", g, src_rank=src_rank):
+        return g.broadcast(arr, src_rank)
 
 
 def allgather(arr, group_name: str = "default"):
-    return _group(group_name).allgather(np.asarray(arr))
+    g = _group(group_name)
+    with _op_span("allgather", g):
+        return g.allgather(np.asarray(arr))
 
 
 def reduce_scatter(arr, group_name: str = "default", op: str = "sum"):
-    return _group(group_name).reduce_scatter(np.asarray(arr), op)
+    g = _group(group_name)
+    with _op_span("reduce_scatter", g, op=op):
+        return g.reduce_scatter(np.asarray(arr), op)
 
 
 def barrier(group_name: str = "default") -> None:
-    _group(group_name).barrier()
+    g = _group(group_name)
+    with _op_span("barrier", g):
+        g.barrier()
 
 
 def send(arr, dst_rank: int, group_name: str = "default") -> None:
-    _group(group_name).send(np.asarray(arr), dst_rank)
+    g = _group(group_name)
+    with _op_span("send", g, dst_rank=dst_rank):
+        g.send(np.asarray(arr), dst_rank)
 
 
 def recv(src_rank: int, group_name: str = "default"):
-    return _group(group_name).recv(src_rank)
+    g = _group(group_name)
+    with _op_span("recv", g, src_rank=src_rank):
+        return g.recv(src_rank)
 
 
 def destroy_collective_group(group_name: str = "default") -> None:

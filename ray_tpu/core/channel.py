@@ -36,6 +36,8 @@ import time
 import uuid
 from typing import Any, Optional
 
+from ..observability.flight_recorder import record as _flight_record
+
 _HDR = struct.Struct("<QQII")  # write_pos, read_pos, reader_closed, writer_closed
 _LEN = struct.Struct("<I")
 _WRAP = 0xFFFFFFFF
@@ -282,9 +284,23 @@ class ChannelReader:
             raise ChannelClosed(self.name)
         if self._conn is None and self._stream is None:
             self._accept(timeout)
-        if self._stream is not None:
-            return self._read_stream(timeout)
-        return self._read_ring(timeout)
+        # Flight-recorder bracket: a `chan.read_wait` with no matching
+        # `chan.read` in a hang dump names the blocked channel.
+        _flight_record("chan.read_wait", self.name)
+        try:
+            payload = (
+                self._read_stream(timeout)
+                if self._stream is not None
+                else self._read_ring(timeout)
+            )
+        except TimeoutError:
+            _flight_record("chan.read_timeout", self.name)
+            raise
+        except ChannelClosed:
+            _flight_record("chan.closed", self.name)
+            raise
+        _flight_record("chan.read", (self.name, len(payload)))
+        return payload
 
     def _read_ring(self, timeout: Optional[float]) -> bytes:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -408,6 +424,18 @@ class ChannelWriter:
     def write_bytes(self, payload: bytes, timeout: Optional[float] = None) -> None:
         if self._closed:
             raise ChannelClosed(self.spec.name)
+        _flight_record("chan.write_wait", self.spec.name)
+        try:
+            self._write_bytes_inner(payload, timeout)
+        except TimeoutError:
+            _flight_record("chan.write_timeout", self.spec.name)
+            raise
+        except ChannelClosed:
+            _flight_record("chan.closed", self.spec.name)
+            raise
+        _flight_record("chan.write", (self.spec.name, len(payload)))
+
+    def _write_bytes_inner(self, payload: bytes, timeout: Optional[float]) -> None:
         if self._stream is not None:
             self._stream.settimeout(timeout)
             try:

@@ -54,8 +54,9 @@ def _entry_from_spec(spec: TaskSpec) -> dict:
         "task_id": spec.task_id.hex(),
         # Span context propagation (reference: tracing_helper.py:165 —
         # context injected into the spec so the executor's span parents
-        # to the submitter's ambient span). None when tracing is off.
-        "trace_ctx": _tracing.current_context(),
+        # to the submitter's ambient span) plus a flow id for the
+        # Perfetto submit->execute arrow. None when tracing is off.
+        "trace_ctx": _tracing.inject_context(),
         "func_blob": spec.func_blob,
         "func_hash": spec.func_hash,
         "method_name": spec.method_name,
@@ -89,6 +90,20 @@ def _entry_from_spec(spec: TaskSpec) -> dict:
         "namespace": spec.options.namespace,
         "desc": spec.description(),
     }
+
+
+def _submit_span(entry: dict):
+    """Submit-side anchor span for the Perfetto submit->execute flow
+    arrow: carries `flow_out` paired with the flow id riding the entry's
+    trace_ctx (the executing span reports it as `flow_in`). Nullcontext
+    when tracing is off — submission pays nothing."""
+    ctx = entry.get("trace_ctx")
+    if not ctx:
+        return _tracing.null_span()
+    return _tracing.span(
+        f"submit {entry.get('desc', 'task')}",
+        {"task_id": entry.get("task_id", ""), "flow_out": ctx.get("flow")},
+    )
 
 
 class _TaskRecord:
@@ -133,6 +148,11 @@ class ClusterRuntime(Runtime):
         from ..utils import internal_metrics as _imet
 
         _imet.configure(node_id=node_id, reporter=self._worker_id)
+        # Flight recorder post-mortems: an unhandled crash in any runtime
+        # process dumps the event ring to the session's flight dir.
+        from ..observability import flight_recorder as _frec
+
+        _frec.install_crash_hooks("driver" if driver else "worker")
         self._actor_location: Dict[str, str] = {}  # actor_id -> raylet sock
         self._raylet_clients: Dict[str, RpcClient] = {}
         self._shutdown_done = False
@@ -1036,7 +1056,8 @@ class ClusterRuntime(Runtime):
         # Bundle-pinned tasks route straight to the node holding the reserved
         # bundle (reference: bundle scheduling bypasses the hybrid policy,
         # scheduling_policy.h NodeAffinity-like pinning).
-        self._submit_entry(entry)
+        with _submit_span(entry):
+            self._submit_entry(entry)
         return spec.return_ids
 
     def create_actor(self, spec: TaskSpec) -> ActorID:
@@ -1080,7 +1101,14 @@ class ClusterRuntime(Runtime):
                     spec.options.scheduling_strategy,
                 )
             with _tracing.span(
-                "actor_launch.submit", {"node_id": node.get("node_id", "")}
+                "actor_launch.submit",
+                {
+                    "node_id": node.get("node_id", ""),
+                    # Tail of the launch flow arrow; the raylet's
+                    # worker_spawn and the worker's init report the same
+                    # id as flow_in, chaining submit->spawn->init.
+                    "flow_out": (entry.get("trace_ctx") or {}).get("flow"),
+                },
             ):
                 self._raylet_for(node["sock"]).call(
                     "create_actor", blob, True, node.get("bundle_index")
@@ -1116,7 +1144,8 @@ class ClusterRuntime(Runtime):
             with self._fast_seal_cv:
                 self._stream_tasks.add(spec.task_id.hex()[:24])
         self._record_submission(entry, "actor_task")
-        self._actor_channel(spec.actor_id.hex()).submit(entry)
+        with _submit_span(entry):
+            self._actor_channel(spec.actor_id.hex()).submit(entry)
         return spec.return_ids
 
     def _actor_channel(self, actor_hex: str):

@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .. import exceptions as exc
 from .. import tracing as _tracing
+from ..observability.flight_recorder import record as _flight_record
 from ..utils import internal_metrics as imet
 from ..utils.config import CONFIG
 from .ids import ObjectID
@@ -46,6 +47,8 @@ class _Worker:
     def __init__(self, worker_id: str, proc: subprocess.Popen, env_key: str = ""):
         self.worker_id = worker_id
         self.proc = proc
+        self.spawned_at = time.monotonic()  # flight_dump skips workers too
+        # young to have bound their SIGUSR2 handler yet
         self.mailbox: "queue.Queue" = queue.Queue()
         self.busy_with: Optional[dict] = None  # task entry being executed
         self.actor_id: Optional[str] = None  # dedicated actor worker
@@ -282,6 +285,7 @@ class RayletService:
         """Queues one entry for the local scheduler; stamps queue-entry
         time so dispatch can report queue-to-dispatch latency."""
         entry["_q_ts"] = time.monotonic()
+        _flight_record("sched.queue", (entry.get("task_id") or "")[:16])
         self._pending.put(entry)
         self._sched_wake.set()
 
@@ -1444,6 +1448,34 @@ class RayletService:
             "pending_qsize": self._pending.qsize(),
         }
 
+    def flight_dump(self) -> dict:
+        """`ray-tpu debug dump`: writes this raylet's flight-recorder ring
+        to the flight dir and fans SIGUSR2 out to its worker processes
+        (each worker's handler dumps its own ring). Returns the raylet's
+        dump path + how many workers were signaled."""
+        from ..observability import flight_recorder as _fr
+
+        path = _fr.dump(reason=f"debug dump (raylet {self.node_id[:12]})")
+        signaled = 0
+        with self._workers_lock:
+            workers = list(self._workers.values())
+        now = time.monotonic()
+        for w in workers:
+            # A worker binds its SIGUSR2 handler first thing in main(),
+            # but a just-spawned interpreter still inside imports would be
+            # KILLED by the signal's default disposition — skip the young.
+            if now - w.spawned_at < 5.0:
+                continue
+            try:
+                if w.proc.poll() is None:
+                    # send_signal, not raw os.kill: PidHandle re-verifies
+                    # /proc starttime so a recycled pid is never signaled.
+                    w.proc.send_signal(signal.SIGUSR2)
+                    signaled += 1
+            except OSError:
+                pass
+        return {"path": path, "workers_signaled": signaled, "dir": _fr.flight_dir()}
+
     # ----------------------------------------------------- worker service
     def worker_poll(self, worker_id: str) -> dict:
         """Long-poll: the worker's task mailbox (reference: the PushTask
@@ -1613,6 +1645,23 @@ class RayletService:
         ts = entry.pop("_q_ts", None)
         if ts is not None:
             imet.SCHED_DISPATCH_LATENCY.observe((time.monotonic() - ts) * 1e3)
+        _flight_record("sched.dispatch", (entry.get("task_id") or "")[:16])
+        # The middle rung of the submit->schedule->execute flow ladder:
+        # a near-zero-width span at the dispatch decision, chained into
+        # the entry's flow id as a Perfetto step event. Tracing off =
+        # one dict lookup.
+        ctx = entry.get("trace_ctx")
+        if ctx and entry.get("type") == "task" and _tracing.is_enabled():
+            with _tracing.continue_context(
+                dict(ctx, flow=None),  # step, not head: flow_in stays unset
+                f"schedule {entry.get('desc', 'task')}",
+                {
+                    "task_id": entry.get("task_id", ""),
+                    "node_id": self.node_id[:12],
+                    "flow_step": ctx.get("flow"),
+                },
+            ):
+                pass
 
     def _dispatch(self, entry: dict) -> bool:
         kind = entry["type"]
@@ -2137,9 +2186,11 @@ def main(argv: List[str]) -> None:
     prestart = int(argv[7]) if len(argv) > 7 and argv[7] else 0
     tcp_spec = argv[8] if len(argv) > 8 and argv[8] else None
 
+    from ..observability.flight_recorder import install_crash_hooks
     from ..utils.sampling_profiler import maybe_start_from_env
 
     maybe_start_from_env("raylet")
+    install_crash_hooks("raylet")
 
     # Multi-host mode: pre-bind the TCP endpoint (resolving an ephemeral
     # port) so the service can advertise it at registration; the service

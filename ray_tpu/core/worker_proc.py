@@ -118,6 +118,13 @@ class _AsyncLoop:
 def main(argv: List[str]) -> None:
     raylet_sock, store_path, gcs_sock, worker_id, node_id = argv
 
+    # FIRST: bind SIGUSR2 (flight-recorder dump) before anything slow —
+    # `ray-tpu debug dump` fans the signal out to workers, and the default
+    # disposition would TERMINATE a worker that hasn't bound it yet.
+    from ..observability.flight_recorder import install_crash_hooks
+
+    install_crash_hooks("worker")
+
     import pickle
     import queue
     import socket as socketlib
@@ -375,8 +382,12 @@ def main(argv: List[str]) -> None:
         from .runtime_context import reset_task_context, set_task_context
 
         from .. import tracing as _tracing
+        from ..observability.flight_recorder import record as _fr
 
         kind = entry["type"]
+        # Always-on black box: the last events before a hang/crash name
+        # the task being executed (complements the opt-in spans).
+        _fr("task.exec", (kind, (entry.get("task_id") or "")[:16]))
         token = set_task_context(entry.get("task_id"), entry.get("actor_id"))
         try:
             # Execution span parented to the submitter's span via the

@@ -1,0 +1,35 @@
+"""Unified tracing/profiling layer: spans, flight recorder, Perfetto.
+
+Three cooperating pieces (reference: the reference splits these across
+util/tracing, `ray timeline`, and nothing at all for the black-box role):
+
+- `ray_tpu.tracing` — opt-in spans with cross-process context + flow-id
+  propagation (RAY_TPU_TRACING=1);
+- `flight_recorder` — an always-on per-process ring of recent runtime
+  events, dumped on demand / crash / cgraph timeout;
+- `perfetto` — merges spans + flight dumps + the task table + internal
+  metrics into one chrome-trace (`ray-tpu trace`).
+"""
+
+from .. import tracing  # noqa: F401  (re-export: the span half)
+from .flight_recorder import (  # noqa: F401
+    RECORDER,
+    FlightRecorder,
+    dump,
+    flight_dir,
+    install_crash_hooks,
+    record,
+)
+from .perfetto import build_trace, export  # noqa: F401
+
+__all__ = [
+    "tracing",
+    "FlightRecorder",
+    "RECORDER",
+    "record",
+    "dump",
+    "flight_dir",
+    "install_crash_hooks",
+    "build_trace",
+    "export",
+]

@@ -1,0 +1,226 @@
+"""Per-process flight recorder: a fixed-size ring of recent runtime events.
+
+The black-box half of the observability layer (reference: the reference
+ships chrome-trace *spans* only when tracing is enabled; a hung gang
+collective or a wedged exec loop leaves nothing behind). This ring is
+ALWAYS on at ~zero cost: `record()` is one tuple store into a
+preallocated list slot — no lock, no allocation beyond the tuple, no IO —
+so the hot paths (channel reads/writes, scheduler dispatch, task
+execution, collective ops) can afford it unconditionally. When something
+hangs or crashes, the last N events ARE the post-mortem: the final
+`chan.read_wait` with no matching `chan.read` names the blocked channel.
+
+Lock-freedom: slot index comes from `itertools.count()` (atomic under
+the GIL — C-level __next__ never releases it) and each slot write is a
+single STORE_SUBSCR. Concurrent writers may interleave slots but never
+corrupt one.
+
+Dump triggers:
+- `ray-tpu debug dump` (raylet RPC fans out SIGUSR2 to its workers),
+- unhandled exceptions in a hooked process (sys/threading excepthook),
+- cgraph `execute()`/`get()` timeout (driver side, naming the blocked
+  channel) and exec-loop crash (actor side).
+
+Env knobs:
+- RAY_TPU_FLIGHT_RECORDER=0     turn the ring off entirely
+- RAY_TPU_FLIGHT_RECORDER_SIZE  ring capacity in events (default 4096)
+- RAY_TPU_FLIGHT_DIR            dump directory (default <trace_dir>/flight)
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_DEFAULT_SIZE = 4096
+
+
+def _enabled() -> bool:
+    return os.environ.get("RAY_TPU_FLIGHT_RECORDER") != "0"
+
+
+def flight_dir() -> str:
+    """Where dumps land; parallel to tracing's span JSONL directory so one
+    `ray-tpu trace` sweep finds both."""
+    d = os.environ.get("RAY_TPU_FLIGHT_DIR")
+    if d:
+        return d
+    # Lazy import (tracing imports this module at load time): the two
+    # layers must agree on the base dir or `ray-tpu trace` sweeps one
+    # location while dumps land in the other.
+    from .. import tracing
+
+    return os.path.join(tracing.trace_dir(), "flight")
+
+
+class FlightRecorder:
+    """One process's ring. Module-level singleton below; separate
+    instances exist only in tests."""
+
+    def __init__(self, size: Optional[int] = None):
+        if size is None:
+            try:
+                size = int(
+                    os.environ.get("RAY_TPU_FLIGHT_RECORDER_SIZE", _DEFAULT_SIZE)
+                )
+            except ValueError:
+                size = _DEFAULT_SIZE
+        self.size = max(16, int(size))
+        self._buf: List[Any] = [None] * self.size
+        self._n = itertools.count()
+        self._enabled = _enabled()
+
+    def record(self, kind: str, detail: Any = None) -> None:
+        """Hot path: one counter bump + one slot store. The sequence
+        number rides the slot so snapshot() can restore exact order —
+        microsecond timestamps tie under bursts."""
+        if not self._enabled:
+            return
+        n = next(self._n)
+        self._buf[n % self.size] = (n, time.time_ns() // 1000, kind, detail)
+
+    def snapshot(self) -> List[tuple]:
+        """(ts_us, kind, detail) events oldest -> newest."""
+        events = [e for e in list(self._buf) if e is not None]
+        events.sort(key=lambda e: e[0])
+        return [e[1:] for e in events]
+
+    def dump(
+        self,
+        path: Optional[str] = None,
+        reason: str = "",
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> Optional[str]:
+        """Writes the ring to JSON; returns the path (None if disabled).
+        Uses a tmp-then-rename write so a crash mid-dump never leaves a
+        truncated file for the trace merger to choke on."""
+        if not self._enabled:
+            return None
+        if path is None:
+            d = flight_dir()
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"flight_{os.getpid()}_{time.time_ns() // 1000}.json"
+            )
+        payload = {
+            "pid": os.getpid(),
+            "reason": reason,
+            "dump_us": time.time_ns() // 1000,
+            "extra": extra or {},
+            "events": self.snapshot(),
+        }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, default=repr)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+
+RECORDER = FlightRecorder()
+record = RECORDER.record  # the hot-path alias instrumented code imports
+
+
+def dump(reason: str = "", extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+    return RECORDER.dump(reason=reason, extra=extra)
+
+
+def collect(directory: Optional[str] = None) -> List[Dict[str, Any]]:
+    """All dumps on disk (every process's), tolerating partial/corrupt
+    files the same way tracing.collect does."""
+    directory = directory or flight_dir()
+    out: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for fname in names:
+        if not (fname.startswith("flight_") and fname.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(directory, fname), errors="replace") as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(payload, dict) and isinstance(payload.get("events"), list):
+            out.append(payload)
+    return out
+
+
+# ----------------------------------------------------------- crash hooks
+_hooks_installed = False
+_hook_lock = threading.Lock()
+
+
+def install_crash_hooks(role: str = "") -> None:
+    """Dump the ring on any unhandled exception (main thread or worker
+    threads), then defer to the previous hook. Also binds SIGUSR2 ->
+    dump where this thread may install signal handlers (`ray-tpu debug
+    dump` fans that signal out to worker processes).
+
+    Installed even when the recorder is DISABLED: the SIGUSR2 handler
+    must exist regardless (the signal's default disposition is process
+    termination — a debug-dump fan-out must never kill a worker), it
+    just dumps nothing."""
+    global _hooks_installed
+    with _hook_lock:
+        if _hooks_installed:
+            return
+        _hooks_installed = True
+
+    prev_except = sys.excepthook
+
+    def _excepthook(tp, val, tb):
+        try:
+            # An empty ring has no post-mortem value (e.g. a worker whose
+            # shutdown path raised before doing any work): skip the file.
+            if RECORDER.snapshot():
+                RECORDER.dump(reason=f"crash[{role}]: {tp.__name__}: {val}")
+        except Exception:
+            pass
+        prev_except(tp, val, tb)
+
+    sys.excepthook = _excepthook
+
+    prev_thread = threading.excepthook
+
+    def _thread_hook(args):
+        try:
+            if RECORDER.snapshot():
+                RECORDER.dump(
+                    reason=(
+                        f"thread-crash[{role}] {getattr(args.thread, 'name', '?')}: "
+                        f"{args.exc_type.__name__}: {args.exc_value}"
+                    )
+                )
+        except Exception:
+            pass
+        prev_thread(args)
+
+    threading.excepthook = _thread_hook
+
+    try:
+        import signal
+
+        prev_usr2 = signal.getsignal(signal.SIGUSR2)
+
+        def _on_usr2(signum, frame):
+            try:
+                if RECORDER.snapshot():
+                    RECORDER.dump(reason=f"signal[{role}]: SIGUSR2")
+            except Exception:
+                pass
+            # Chain a pre-existing user handler (e.g. an application's own
+            # dump-on-signal); SIG_DFL/SIG_IGN are not callables.
+            if callable(prev_usr2):
+                prev_usr2(signum, frame)
+
+        signal.signal(signal.SIGUSR2, _on_usr2)
+    except (ValueError, OSError, AttributeError):
+        pass  # not the main thread / platform without SIGUSR2

@@ -514,14 +514,84 @@ def cmd_timeline(args) -> None:
     from .utils import state
 
     events = state.timeline(args.out)
-    n_spans = sum(1 for e in events if e.get("cat") == "span")
-    extra = f" (+{n_spans} trace spans)" if n_spans else ""
+    n_spans = sum(
+        1 for e in events if str(e.get("cat", "")).startswith("span")
+    )
+    n_open = sum(1 for e in events if e.get("tid") == "open at dump")
+    extra = f" (+{n_spans} trace spans, {n_open} open at dump)" if n_spans else ""
     print(f"wrote {len(events)} task spans{extra} to {args.out} (open in Perfetto)")
     if not n_spans:
         print(
             "hint: run the workload with RAY_TPU_TRACING=1 to include "
             "runtime spans (actor-launch phase breakdown)"
         )
+
+
+def cmd_trace(args) -> None:
+    """`ray-tpu trace --out trace.json`: the full Perfetto merge — every
+    process's tracing spans, flight-recorder dumps, the GCS task table,
+    and internal-metrics counter tracks, with submit->schedule->execute
+    and request->replica->response flow arrows."""
+    _connect(args)
+    from .observability import perfetto
+    from .utils import state
+
+    task_events = state.task_timeline_events()
+    try:
+        metrics = state.internal_metrics()
+    except Exception:
+        metrics = []
+    result = perfetto.export(
+        path=args.out, task_events=task_events, metrics=metrics
+    )
+    s = result["summary"]
+    print(
+        f"wrote {s['events']} events to {args.out} "
+        f"({s['spans']} spans, {s['flows']} flow arrows, "
+        f"{s['flight_dumps']} flight dumps, {s['task_events']} task rows) "
+        "— open at ui.perfetto.dev"
+    )
+    if not s["spans"]:
+        print(
+            "hint: run the workload with RAY_TPU_TRACING=1 to record "
+            "spans; the flight recorder is always on"
+        )
+
+
+def cmd_debug(args) -> None:
+    """`ray-tpu debug dump`: flight-recorder post-mortem on demand — every
+    raylet dumps its ring and fans SIGUSR2 out to its workers (their
+    handlers dump too); the driver CLI dumps its own."""
+    if args.action != "dump":
+        raise SystemExit(f"unknown debug action {args.action!r} (expected: dump)")
+    _connect(args)
+    from .core.rpc import RpcClient
+    from .observability import flight_recorder
+    from .utils import state
+
+    dumped = []
+    signaled = 0
+    for n in state.list_nodes():
+        if not n.get("Alive"):
+            continue
+        try:
+            res = RpcClient(n["sock"], connect_timeout=5.0).call(
+                "flight_dump", timeout=10.0
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"warning: node {n['NodeID'][:12]} dump failed: {e}", file=sys.stderr)
+            continue
+        if res.get("path"):
+            dumped.append(res["path"])
+        signaled += res.get("workers_signaled", 0)
+    own = flight_recorder.dump(reason="debug dump (cli)")
+    if own:
+        dumped.append(own)
+    print(
+        f"wrote {len(dumped)} flight-recorder dumps "
+        f"(+{signaled} workers signaled) under {flight_recorder.flight_dir()}"
+    )
+    print("merge into a timeline with: ray-tpu trace --out trace.json")
 
 
 def cmd_dashboard(args) -> None:
@@ -625,6 +695,22 @@ def main(argv=None) -> None:
     p.add_argument("--address", default=None)
     p.add_argument("--out", default="ray_tpu_timeline.json")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser(
+        "trace",
+        help="export the unified Perfetto trace (spans + flight rings + "
+        "task table + metric counters, with flow arrows)",
+    )
+    p.add_argument("--address", default=None)
+    p.add_argument("--out", default="trace.json")
+    p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "debug", help="debug utilities: `debug dump` writes flight-recorder rings"
+    )
+    p.add_argument("action", help="dump")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_debug)
 
     args = ap.parse_args(argv)
     args.fn(args)

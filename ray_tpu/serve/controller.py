@@ -17,8 +17,13 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import api
+from .. import tracing as _tracing
 
 CONTROLLER_NAME = "__serve_controller__"
+
+# Sentinel: "the stream produced no first chunk" (distinct from a handler
+# legitimately yielding None).
+_STREAM_EXHAUSTED = object()
 
 
 class Replica:
@@ -81,9 +86,18 @@ class Replica:
             fn = self._callable if method == "__call__" else getattr(self._callable, method)
             if method == "__call__" and not callable(self._callable):
                 raise TypeError("deployment target is not callable")
-            out = fn(*args, **kwargs)
-            if inspect.iscoroutine(out):
-                out = asyncio.run(out)
+            # Replica-side serve span: nests under the actor-task
+            # execution span (whose trace_ctx came from the router), so
+            # proxy/router/replica share one trace_id and the gap between
+            # the router span's start and this span's start IS the
+            # routing+dispatch half of TTFT.
+            with _tracing.span(
+                f"serve.replica {self._app_name}",
+                {"app": self._app_name, "serve_method": method},
+            ):
+                out = fn(*args, **kwargs)
+                if inspect.iscoroutine(out):
+                    out = asyncio.run(out)
             if inspect.isgenerator(out) or inspect.isasyncgen(out):
                 # Register a stream instead of materializing it. The
                 # request stays in the _ongoing count until the stream
@@ -167,23 +181,48 @@ class Replica:
             fn = self._callable if method == "__call__" else getattr(self._callable, method)
             if method == "__call__" and not callable(self._callable):
                 raise TypeError("deployment target is not callable")
-            out = fn(*args, **kwargs)
-            if inspect.iscoroutine(out):
-                out = asyncio.run(out)
-            if inspect.isasyncgen(out):
-                loop = asyncio.new_event_loop()
-                try:
+            # Streaming: the span covers handler invocation THROUGH the
+            # first chunk — the serve-level TTFT. A generator's body runs
+            # nothing until first pulled, so the first pull happens inside
+            # the span; the rest of the drain (the caller's pace, not the
+            # replica's) stays outside it.
+            first = _STREAM_EXHAUSTED
+            loop = None
+            try:
+                with _tracing.span(
+                    f"serve.replica {self._app_name}",
+                    {"app": self._app_name, "serve_method": method, "stream": True},
+                ):
+                    out = fn(*args, **kwargs)
+                    if inspect.iscoroutine(out):
+                        out = asyncio.run(out)
+                    if inspect.isasyncgen(out):
+                        loop = asyncio.new_event_loop()
+                        try:
+                            first = loop.run_until_complete(out.__anext__())
+                        except StopAsyncIteration:
+                            pass
+                    elif inspect.isgenerator(out):
+                        first = next(out, _STREAM_EXHAUSTED)
+                    else:
+                        first = out  # non-generator handler: a one-chunk stream
+                if first is _STREAM_EXHAUSTED:
+                    return
+                yield first
+                if inspect.isasyncgen(out):
                     while True:
                         try:
                             yield loop.run_until_complete(out.__anext__())
                         except StopAsyncIteration:
                             break
-                finally:
+                elif inspect.isgenerator(out):
+                    yield from out
+            finally:
+                # One close for every exit: first-chunk failure, a consumer
+                # abandoning the stream (GeneratorExit at any yield), or a
+                # clean drain — leaked loops cost an epoll fd each.
+                if loop is not None:
                     loop.close()
-            elif inspect.isgenerator(out):
-                yield from out
-            else:
-                yield out  # non-generator handler: a one-chunk stream
         finally:
             with self._lock:
                 self._ongoing -= 1

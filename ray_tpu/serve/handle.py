@@ -19,6 +19,7 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from .. import api
+from .. import tracing as _tracing
 from .controller import CONTROLLER_NAME, Replica
 
 _STREAM_MARKER = Replica.STREAM_MARKER  # single definition of the sentinel
@@ -45,11 +46,15 @@ def _stream_executor():
 class DeploymentResponse:
     """Future-like response (reference: serve/handle.py DeploymentResponse)."""
 
-    def __init__(self, ref, on_done, replica=None):
+    def __init__(self, ref, on_done, replica=None, trace=None):
         self._ref = ref
         self._on_done = on_done
         self._replica = replica
         self._done = False
+        # (app, trace_ctx) from the handle: result() re-roots the request
+        # span's context (same trace_id) and ends the request->response
+        # flow arrow via the flow id riding the ctx.
+        self._trace = trace
 
     def _finish(self):
         if not self._done:
@@ -57,11 +62,19 @@ class DeploymentResponse:
             self._on_done()
 
     def result(self, timeout: Optional[float] = None) -> Any:
+        import contextlib
         import time as _time
 
         deadline = None if timeout is None else _time.monotonic() + timeout
+        span_cm = contextlib.nullcontext()
+        if self._trace and _tracing.is_enabled():
+            app, ctx = self._trace
+            span_cm = _tracing.continue_context(
+                ctx, f"serve.response {app}", {"app": app}
+            )
         try:
-            out = api.get(self._ref, timeout=timeout)
+            with span_cm:
+                out = api.get(self._ref, timeout=timeout)
         except BaseException:
             self._finish()
             raise
@@ -219,13 +232,47 @@ class DeploymentHandle:
         context = (
             {"multiplexed_model_id": self._mux_id} if self._mux_id is not None else None
         )
+        # Router span: the replica-side handling span parents to it (and
+        # shares its trace_id) via the actor-task trace_ctx the core
+        # submission path injects; `flow_out` additionally arrows
+        # request->response in the Perfetto view. TTFT falls out of the
+        # replica span's start minus this span's start.
+        traced = _tracing.is_enabled()
+        resp_flow = _tracing.new_flow_id() if traced else None
+        span_cm = (
+            _tracing.span(
+                f"serve.request {self._app}",
+                {
+                    "app": self._app,
+                    "method": self._method,
+                    "replica": str(rid),
+                    "flow_out": resp_flow,
+                },
+            )
+            if traced
+            else None
+        )
         if self._stream:
-            ref_gen = replica.handle_request_stream.options(
-                num_returns="streaming"
-            ).remote(self._method, args, kwargs, context)
+            with span_cm or _tracing.null_span():
+                ref_gen = replica.handle_request_stream.options(
+                    num_returns="streaming"
+                ).remote(self._method, args, kwargs, context)
             return DeploymentResponseGenerator(ref_gen, done)
-        ref = replica.handle_request.remote(self._method, args, kwargs, context)
-        return DeploymentResponse(ref, done, replica=replica)
+        resp_ctx = None
+        with span_cm or _tracing.null_span() as sp:
+            ref = replica.handle_request.remote(self._method, args, kwargs, context)
+            if sp is not None:
+                resp_ctx = {
+                    "trace_id": sp["trace_id"],
+                    "span_id": sp["span_id"],
+                    "flow": resp_flow,
+                }
+        return DeploymentResponse(
+            ref,
+            done,
+            replica=replica,
+            trace=(self._app, resp_ctx) if resp_ctx else None,
+        )
 
 
 # ------------------------------------------------------------------ proxy
@@ -270,6 +317,17 @@ class ProxyASGIApp:
                 self._inflight[0] -= 1
 
     async def _serve_one(self, scope, receive, send):
+        # Root span of an HTTP request's trace: the handle's serve.request
+        # span (opened inside, same thread/context) parents here, the
+        # replica execution follows via the propagated trace_ctx — one
+        # trace_id across proxy -> router -> replica.
+        with _tracing.span(
+            f"serve.http {scope.get('path', '/')}",
+            {"method": scope.get("method", "?"), "path": scope.get("path", "")},
+        ):
+            await self._serve_one_traced(scope, receive, send)
+
+    async def _serve_one_traced(self, scope, receive, send):
         path = scope["path"].strip("/")
         app = path.split("/")[0] if path else ""
 

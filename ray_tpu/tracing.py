@@ -11,12 +11,22 @@ entries carry `trace_ctx`, and exporters are pluggable — the default
 writes JSONL under the session dir so spans from every process (driver,
 raylets' workers) merge by trace_id. `collect()` reassembles the tree.
 
+Cross-process causality stitches two ways: parent links (this module's
+context propagation) and **flow ids** for the Perfetto exporter's arrows
+(observability/perfetto.py). `inject_context()` mints a flow id at
+submit time; the submit-side span carries it as `flow_out`, the
+executing-side span as `flow_in`, and intermediate hops (the raylet's
+schedule span) as `flow_step` — the exporter pairs them into s/t/f
+chrome-trace flow events.
+
 Opt-in: `RAY_TPU_TRACING=1` (inherited by daemons/workers) or
-`tracing.enable(exporter)` in-process.
+`tracing.enable(exporter)` in-process. Span open/close additionally feed
+the always-on flight recorder (observability/flight_recorder.py).
 """
 
 from __future__ import annotations
 
+import atexit
 import contextlib
 import contextvars
 import json
@@ -25,6 +35,8 @@ import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional
+
+from .observability.flight_recorder import record as _frec
 
 _ctx: "contextvars.ContextVar[Optional[dict]]" = contextvars.ContextVar(
     "ray_tpu_trace_ctx", default=None
@@ -52,21 +64,32 @@ class InMemoryExporter(SpanExporter):
 
 
 class JsonlExporter(SpanExporter):
-    """One JSONL file per process under <dir>/; `collect()` merges them."""
+    """One JSONL file per process under <dir>/; `collect()` merges them.
+
+    Registered with atexit so a process that exits without calling
+    disable() still flushes + fsyncs its tail — a worker torn down by
+    the raylet must not leave its last spans in libc buffers (the
+    truncated-line case collect() additionally tolerates)."""
 
     def __init__(self, directory: str):
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, f"spans_{os.getpid()}.jsonl")
         self._f = open(self.path, "a", buffering=1)
         self._flock = threading.Lock()
+        atexit.register(self.shutdown)
 
     def export(self, span: dict) -> None:
         with self._flock:
-            self._f.write(json.dumps(span) + "\n")
+            self._f.write(json.dumps(span, default=repr) + "\n")
 
     def shutdown(self) -> None:
         with contextlib.suppress(Exception):
-            self._f.close()
+            with self._flock:
+                if not self._f.closed:
+                    self._f.flush()
+                    os.fsync(self._f.fileno())
+                    self._f.close()
+        atexit.unregister(self.shutdown)
 
 
 def enable(exporter: Optional[SpanExporter] = None) -> None:
@@ -112,6 +135,24 @@ def is_enabled() -> bool:
     return _active() is not None
 
 
+def new_flow_id() -> str:
+    """A fresh id for one cross-process edge (submit->execute,
+    request->replica); rendered as a Perfetto flow arrow."""
+    return uuid.uuid4().hex[:16]
+
+
+def null_span(name=None, attrs=None):
+    """A no-op stand-in for span(); hot loops that check is_enabled()
+    once pick between the two instead of re-checking per span."""
+    return contextlib.nullcontext()
+
+
+def maybe_span(name: str, attrs: Optional[Dict[str, Any]] = None):
+    """span() when tracing is on, else a no-op context — the one-liner
+    for instrumenting a call site without an enabled-check of its own."""
+    return span(name, attrs) if is_enabled() else contextlib.nullcontext()
+
+
 # ----------------------------------------------------------------- spans
 @contextlib.contextmanager
 def span(name: str, attrs: Optional[Dict[str, Any]] = None):
@@ -129,10 +170,15 @@ def span(name: str, attrs: Optional[Dict[str, Any]] = None):
         "parent_id": parent["span_id"] if parent else None,
         "name": name,
         "pid": os.getpid(),
+        "tid": threading.get_ident() & 0xFFFFFFFF,
         "start_us": int(time.time() * 1e6),
         "attrs": attrs or {},
     }
     token = _ctx.set({"trace_id": sp["trace_id"], "span_id": sp["span_id"]})
+    # Flight-record detail carries the thread id: the dump-side
+    # reconstruction of still-open spans must not collide two concurrent
+    # same-named spans (e.g. two exec loops both in channel_wait).
+    _frec("span_open", (name, sp["tid"]))
     try:
         yield sp
     except BaseException as e:
@@ -141,6 +187,7 @@ def span(name: str, attrs: Optional[Dict[str, Any]] = None):
     finally:
         _ctx.reset(token)
         sp["end_us"] = int(time.time() * 1e6)
+        _frec("span_close", (name, sp["tid"]))
         exp.export(sp)
 
 
@@ -152,12 +199,40 @@ def current_context() -> Optional[dict]:
     return _ctx.get()
 
 
+def inject_context() -> Optional[dict]:
+    """The context a submitter stamps into an outgoing task entry: the
+    ambient {trace_id, span_id} plus a fresh flow id for the Perfetto
+    submit->execute arrow. With no ambient span the entry still gets a
+    trace_id (the execution roots a new trace) and a flow id, so the
+    arrow exists even for fire-and-forget submissions."""
+    if not is_enabled():
+        return None
+    ctx = _ctx.get()
+    return {
+        "trace_id": ctx["trace_id"] if ctx else uuid.uuid4().hex,
+        "span_id": ctx["span_id"] if ctx else None,
+        "flow": new_flow_id(),
+    }
+
+
 @contextlib.contextmanager
 def continue_context(trace_ctx: Optional[dict], name: str, attrs=None):
     """Worker side: re-roots the ambient context from a propagated
-    trace_ctx, then opens an execution span under it."""
+    trace_ctx, then opens an execution span under it. A flow id riding
+    the context lands on the execution span as `flow_in` — the head of
+    the Perfetto arrow whose tail is the submit-side `flow_out`."""
     if trace_ctx and is_enabled():
-        token = _ctx.set(trace_ctx)
+        if trace_ctx.get("flow"):
+            attrs = dict(attrs or {})
+            attrs["flow_in"] = trace_ctx["flow"]
+        # Copy: the ambient context must carry ONLY the span identity —
+        # a flow id leaking into child spans would pair arrows twice.
+        token = _ctx.set(
+            {
+                "trace_id": trace_ctx.get("trace_id"),
+                "span_id": trace_ctx.get("span_id"),
+            }
+        )
         try:
             with span(name, attrs) as sp:
                 yield sp
@@ -170,20 +245,34 @@ def continue_context(trace_ctx: Optional[dict], name: str, attrs=None):
 
 # ------------------------------------------------------------- collection
 def collect(directory: Optional[str] = None) -> List[dict]:
-    """Merges every process's JSONL spans (sorted by start time)."""
+    """Merges every process's JSONL spans (stable-sorted by start time).
+
+    Tolerant of truncated/corrupt lines: a worker killed mid-write leaves
+    a partial last line (or raw bytes under memory pressure), and one
+    poisoned file must not discard every other process's spans — skip the
+    line, keep the rest."""
     directory = directory or trace_dir()
     spans: List[dict] = []
     try:
-        names = os.listdir(directory)
+        names = sorted(os.listdir(directory))
     except OSError:
         return spans
     for fname in names:
         if not fname.endswith(".jsonl"):
             continue
-        with open(os.path.join(directory, fname)) as f:
-            for line in f:
-                with contextlib.suppress(json.JSONDecodeError):
-                    spans.append(json.loads(line))
+        try:
+            with open(os.path.join(directory, fname), errors="replace") as f:
+                for line in f:
+                    try:
+                        sp = json.loads(line)
+                    except ValueError:
+                        continue  # truncated/corrupt line
+                    # A partial write can still parse (e.g. a bare number
+                    # from a split record): only span-shaped dicts merge.
+                    if isinstance(sp, dict) and "span_id" in sp:
+                        spans.append(sp)
+        except OSError:
+            continue
     spans.sort(key=lambda s: s.get("start_us", 0))
     return spans
 

@@ -77,17 +77,10 @@ def get_task(task_id: str) -> Optional[Dict[str, Any]]:
     return _gcs().call("get_task_states", [task_id]).get(task_id)
 
 
-def timeline(path: Optional[str] = None) -> Any:
-    """Chrome-trace (Perfetto/chrome://tracing) export of task execution
-    spans (reference: `ray timeline`, python/ray/_private/state.py
-    chrome_tracing_dump). Returns the event list; writes JSON when `path`
-    is given. With tracing enabled (RAY_TPU_TRACING=1) the collected
-    trace spans merge in too — including the actor-launch phases
-    (gcs_register -> submit -> worker_spawn -> init), so a slow launch
-    decomposes visually instead of showing as one opaque gap."""
-    import json
-
-    events = []
+def task_timeline_events() -> List[Dict[str, Any]]:
+    """Chrome-trace duration events from the GCS task table (RUNNING ->
+    FINISHED/FAILED transitions), one `node:<id>` track per node."""
+    events: List[Dict[str, Any]] = []
     for rec in list_tasks(limit=100_000):
         hist = rec.get("history") or []
         start = None
@@ -109,31 +102,38 @@ def timeline(path: Optional[str] = None) -> Any:
                     }
                 )
                 start = None
+    return events
+
+
+def timeline(path: Optional[str] = None) -> Any:
+    """Chrome-trace (Perfetto/chrome://tracing) export of task execution
+    spans (reference: `ray timeline`, python/ray/_private/state.py
+    chrome_tracing_dump). Returns the event list; writes JSON when `path`
+    is given. With tracing enabled (RAY_TPU_TRACING=1) every collected
+    trace span merges in too — task submit/execute, the actor-launch
+    phases (gcs_register -> submit -> worker_spawn -> init), serve
+    request/replica spans, and cgraph execute/iteration spans — so a slow
+    path decomposes visually instead of showing as one opaque gap. Spans
+    that never closed land on an "open at dump" track (a broken import
+    would otherwise hide the whole export); the result is stable-sorted
+    by start time. For the full merge (flight-recorder rings, metrics
+    counter tracks, flow arrows) use `ray-tpu trace` /
+    observability.perfetto.export."""
+    import json
+    import time
+
+    from ..observability import perfetto
+
+    events = task_timeline_events()
     from .. import tracing
 
-    for sp in tracing.collect():
-        start_us = sp.get("start_us")
-        if start_us is None:
-            continue
-        events.append(
-            {
-                "name": sp.get("name", "span"),
-                "cat": "span",
-                "ph": "X",
-                "ts": start_us,
-                "dur": max(0.0, sp.get("end_us", start_us) - start_us),
-                "pid": f"proc:{sp.get('pid', '?')}",
-                "tid": (sp.get("trace_id") or "")[:8],
-                "args": {
-                    "span_id": sp.get("span_id"),
-                    "parent_id": sp.get("parent_id"),
-                    **(sp.get("attrs") or {}),
-                },
-            }
-        )
+    events += perfetto.span_events(
+        tracing.collect(), dump_us=int(time.time() * 1e6)
+    )
+    events.sort(key=lambda e: e.get("ts", 0))  # stable: ties keep order
     if path:
         with open(path, "w") as f:
-            json.dump(events, f)
+            json.dump(events, f, default=repr)
     return events
 
 

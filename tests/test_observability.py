@@ -1,0 +1,286 @@
+"""Observability layer: flight recorder, Perfetto export, serve/cgraph
+trace propagation (ISSUE 6 — flight recorder + unified timeline)."""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import tracing
+from ray_tpu.observability import flight_recorder, perfetto
+
+
+# ------------------------------------------------------- flight recorder
+def test_flight_recorder_ring_wraparound():
+    rec = flight_recorder.FlightRecorder(size=32)
+    rec._enabled = True
+    for i in range(100):
+        rec.record("evt", i)
+    events = rec.snapshot()
+    assert len(events) == 32  # ring holds exactly `size` most-recent
+    details = [e[2] for e in events]
+    assert details == list(range(68, 100))  # oldest 68 were overwritten
+    ts = [e[0] for e in events]
+    assert ts == sorted(ts)
+
+
+def test_flight_recorder_dump_and_collect(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_FLIGHT_DIR", str(tmp_path))
+    rec = flight_recorder.FlightRecorder(size=16)
+    rec._enabled = True
+    rec.record("chan.read_wait", "edge-a")
+    path = rec.dump(reason="unit test", extra={"blocked_channel": "edge-a"})
+    assert path and os.path.exists(path)
+    dumps = flight_recorder.collect()
+    assert len(dumps) == 1
+    assert dumps[0]["reason"] == "unit test"
+    assert dumps[0]["extra"]["blocked_channel"] == "edge-a"
+    assert dumps[0]["events"][0][1] == "chan.read_wait"
+    # A truncated dump (process died mid-write) must not poison collect.
+    (tmp_path / "flight_999_1.json").write_text('{"pid": 999, "eve')
+    assert len(flight_recorder.collect()) == 1
+
+
+def test_flight_recorder_disabled_records_nothing(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_FLIGHT_RECORDER", "0")
+    rec = flight_recorder.FlightRecorder(size=16)
+    rec.record("evt", 1)
+    assert rec.snapshot() == []
+    assert rec.dump(reason="x") is None
+
+
+# ----------------------------------------------------- tracing satellites
+def test_collect_tolerates_corrupt_jsonl(tmp_path):
+    """A worker killed mid-write leaves a truncated/garbage line; the
+    merge must keep every other span instead of poisoning the export."""
+    good = {"span_id": "abc", "trace_id": "t1", "name": "ok", "start_us": 5}
+    with open(tmp_path / "spans_1.jsonl", "w") as f:
+        f.write(json.dumps(good) + "\n")
+        f.write('{"span_id": "trunc", "name": "half\n')  # truncated
+        f.write("12345\n")  # valid JSON, not a span record
+        f.write("\x00\x80\xff garbage\n")  # binary junk
+    with open(tmp_path / "spans_2.jsonl", "wb") as f:
+        f.write(b"\x00\x01\x02 not even text\n")
+    spans = tracing.collect(str(tmp_path))
+    assert [s["span_id"] for s in spans] == ["abc"]
+
+
+def test_jsonl_exporter_flushes_on_shutdown(tmp_path):
+    exp = tracing.JsonlExporter(str(tmp_path))
+    exp.export({"span_id": "s1", "name": "x", "start_us": 1, "end_us": 2})
+    exp.shutdown()
+    exp.shutdown()  # idempotent (atexit may follow an explicit disable)
+    spans = tracing.collect(str(tmp_path))
+    assert [s["span_id"] for s in spans] == ["s1"]
+
+
+# ------------------------------------------------------- perfetto export
+def test_perfetto_open_spans_and_flow_pairing():
+    t0 = 1_000_000
+    spans = [
+        # submit -> schedule -> execute, stitched by one flow id.
+        {"span_id": "a", "trace_id": "t", "name": "submit f", "pid": 1,
+         "tid": 1, "start_us": t0, "end_us": t0 + 10,
+         "attrs": {"flow_out": "fl1"}},
+        {"span_id": "b", "trace_id": "t", "name": "schedule f", "pid": 2,
+         "tid": 1, "start_us": t0 + 12, "end_us": t0 + 13,
+         "attrs": {"flow_step": "fl1"}},
+        {"span_id": "c", "trace_id": "t", "name": "run f", "pid": 3,
+         "tid": 1, "start_us": t0 + 20, "end_us": t0 + 90,
+         "attrs": {"flow_in": "fl1"}},
+        # Never closed: lands on the open-at-dump track, not dropped.
+        {"span_id": "d", "trace_id": "t", "name": "hung", "pid": 3,
+         "tid": 1, "start_us": t0 + 30, "attrs": {}},
+        # Dangling flow (executor died): must not emit an unpaired chain.
+        {"span_id": "e", "trace_id": "t", "name": "submit g", "pid": 1,
+         "tid": 1, "start_us": t0 + 40, "end_us": t0 + 41,
+         "attrs": {"flow_out": "fl2"}},
+    ]
+    dumps = [{"pid": 3, "reason": "hang", "dump_us": t0 + 200,
+              "events": [[t0 + 50, "chan.read_wait", "edge-x"],
+                         [t0 + 60, "span_open", "wedged"]]}]
+    trace = perfetto.build_trace(spans=spans, dumps=dumps)
+    json.loads(json.dumps(trace))  # round-trips as valid JSON
+    events = trace["traceEvents"]
+    flows = [e for e in events if e.get("cat") == "flow"]
+    by_ph = {}
+    for e in flows:
+        by_ph.setdefault(e["ph"], []).append(e["id"])
+    # fl1 chains s -> t -> f; the dangling fl2 is suppressed entirely.
+    assert by_ph.get("s") == ["fl1"]
+    assert by_ph.get("t") == ["fl1"]
+    assert by_ph.get("f") == ["fl1"]
+    assert all(e["ph"] != "f" or e.get("bp") == "e" for e in flows)
+    open_events = [e for e in events if e.get("tid") == perfetto.OPEN_TRACK]
+    assert {e["name"] for e in open_events} == {"hung", "wedged"}
+    assert all(e["dur"] >= 1 for e in open_events)
+    instants = [e for e in events if e.get("cat") == "flight"]
+    assert [e["name"] for e in instants] == ["chan.read_wait"]
+    # Metadata precedes data events and names every pid.
+    metas = [e for e in events if e.get("ph") == "M"]
+    assert {e["pid"] for e in metas} >= {1, 2, 3}
+    assert events.index(metas[-1]) < min(
+        events.index(e) for e in events if e.get("ph") != "M"
+    )
+
+
+def test_counter_events_from_metrics():
+    metrics = [
+        {"name": "raytpu_tasks_total", "kind": "counter", "value": 7.0,
+         "tags": {"node_id": "abcd1234ef", "component": "raylet"}},
+        {"name": "raytpu_lat_ms", "kind": "histogram", "value": 1.0},  # skipped
+    ]
+    events = perfetto.counter_events(metrics, ts_us=123)
+    assert len(events) == 1
+    assert events[0]["ph"] == "C"
+    assert events[0]["args"]["value"] == 7.0
+    assert "component=raylet" in events[0]["name"]
+
+
+# -------------------------------------------------- end-to-end (cluster)
+def test_serve_request_trace_and_export(tmp_path, monkeypatch):
+    """One serve request: proxy-less handle call. The router span
+    (serve.request), the replica execution span (run ...), and the
+    replica-level span (serve.replica) share one trace_id; TTFT is
+    measurable as replica start - request start; the Perfetto export is
+    valid JSON with every flow chain paired."""
+    trace_dir = str(tmp_path / "traces")
+    monkeypatch.setenv("RAY_TPU_TRACING", "1")
+    monkeypatch.setenv("RAY_TPU_TRACE_DIR", trace_dir)
+    rt.shutdown()
+    rt.init(num_cpus=4, num_workers=2)
+    tracing.enable()
+    from ray_tpu import serve
+
+    try:
+        @serve.deployment
+        class Echo:
+            def __call__(self, x):
+                return {"echo": x}
+
+        handle = serve.run(Echo.bind(), name="traced_app")
+        out = handle.remote({"q": 1}).result(timeout=120)
+        assert out == {"echo": {"q": 1}}
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            rt.shutdown()
+            tracing.disable()
+
+    spans = tracing.collect(trace_dir)
+    req = [s for s in spans if s["name"] == "serve.request traced_app"]
+    rep = [s for s in spans if s["name"] == "serve.replica traced_app"]
+    resp = [s for s in spans if s["name"] == "serve.response traced_app"]
+    assert req and rep and resp
+    # One trace across processes (router in the driver, replica in a
+    # worker), with a measurable TTFT.
+    assert rep[0]["trace_id"] == req[0]["trace_id"] == resp[0]["trace_id"]
+    assert rep[0]["pid"] != req[0]["pid"]
+    ttft_us = rep[0]["start_us"] - req[0]["start_us"]
+    assert 0 <= ttft_us < 60_000_000
+    # request -> response flow arrow.
+    assert req[0]["attrs"]["flow_out"] == resp[0]["attrs"]["flow_in"]
+
+    out_path = str(tmp_path / "trace.json")
+    result = perfetto.export(path=out_path, trace_directory=trace_dir)
+    with open(out_path) as f:
+        trace = json.load(f)
+    flows = [e for e in trace["traceEvents"] if e.get("cat") == "flow"]
+    starts = {e["id"] for e in flows if e["ph"] == "s"}
+    ends = {e["id"] for e in flows if e["ph"] == "f"}
+    assert starts and starts == ends
+    assert result["summary"]["flows"] == len(starts)
+
+
+@pytest.mark.slow
+def test_cgraph_iteration_spans(tmp_path, monkeypatch):
+    """A 3-stage compiled pipeline under tracing: every actor's exec loop
+    emits per-iteration spans (channel-wait/compute sub-spans) sharing
+    the graph's compile-time trace_id with the driver's execute spans,
+    chained per iteration by cg:<dag>:<seq> flow ids."""
+    trace_dir = str(tmp_path / "traces")
+    monkeypatch.setenv("RAY_TPU_TRACING", "1")
+    monkeypatch.setenv("RAY_TPU_TRACE_DIR", trace_dir)
+    rt.shutdown()
+    rt.init(num_cpus=4, num_workers=2)
+    tracing.enable()
+    from ray_tpu.dag import InputNode
+
+    try:
+        @rt.remote
+        class Stage:
+            def apply(self, x):
+                return x + 1
+
+        stages = [Stage.remote() for _ in range(3)]
+        with InputNode() as inp:
+            node = inp
+            for s in stages:
+                node = s.apply.bind(node)
+        cdag = node.experimental_compile()
+        for i in range(3):
+            assert cdag.execute(i).get(timeout=60) == i + 3
+        cdag.teardown()
+    finally:
+        rt.shutdown()
+        tracing.disable()
+
+    spans = tracing.collect(trace_dir)
+    execs = [s for s in spans if s["name"].startswith("cgraph.execute")]
+    iters = [s for s in spans if s["name"].startswith("cgraph.iter")]
+    waits = [s for s in spans if s["name"] == "cgraph.channel_wait"]
+    computes = [s for s in spans if s["name"].startswith("cgraph.compute")]
+    rounds = [s for s in spans if s["name"].startswith("cgraph.round")]
+    assert len(execs) == 3 and len(rounds) == 3
+    assert len(iters) >= 9  # 3 actors x 3 iterations (+ teardown races)
+    assert waits and computes
+    tid = execs[0]["trace_id"]
+    assert all(s["trace_id"] == tid for s in iters + rounds)
+    # Iteration spans run in the actors' worker processes, not the driver.
+    assert {s["pid"] for s in iters} - {execs[0]["pid"]}
+    # Per-iteration flow chain: execute (tail) -> iters (steps) -> round.
+    for seq in range(3):
+        fid = f"cg:{execs[0]['attrs']['dag']}:{seq}"
+        assert any(s["attrs"].get("flow_out") == fid for s in execs)
+        assert any(s["attrs"].get("flow_step") == fid for s in iters)
+        assert any(s["attrs"].get("flow_in") == fid for s in rounds)
+    # Sub-spans parent under their iteration span.
+    iter_ids = {s["span_id"] for s in iters}
+    assert all(s["parent_id"] in iter_ids for s in waits + computes)
+
+
+@pytest.mark.slow
+def test_cgraph_timeout_writes_flight_dump(tmp_path, monkeypatch):
+    """A deliberately-stuck compiled graph: get(timeout) raises AND the
+    driver writes a flight-recorder dump naming the blocked channel."""
+    monkeypatch.setenv("RAY_TPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    rt.shutdown()
+    rt.init(num_cpus=4, num_workers=2)
+    from ray_tpu.dag import InputNode
+
+    try:
+        @rt.remote
+        class Stuck:
+            def apply(self, x):
+                time.sleep(600)
+
+        s = Stuck.remote()
+        with InputNode() as inp:
+            node = s.apply.bind(inp)
+        cdag = node.experimental_compile()
+        ref = cdag.execute(1)
+        with pytest.raises(TimeoutError, match="blocked on channel"):
+            ref.get(timeout=2)
+        dumps = flight_recorder.collect()
+        assert len(dumps) == 1
+        assert "blocked on output channel" in dumps[0]["reason"]
+        assert dumps[0]["extra"]["blocked_channel"].endswith("->driver")
+        # The ring's recent events include the driver-side channel waits.
+        kinds = {e[1] for e in dumps[0]["events"]}
+        assert "chan.read_wait" in kinds
+        cdag.teardown()
+    finally:
+        rt.shutdown()

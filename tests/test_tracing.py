@@ -70,3 +70,9 @@ def test_nested_task_tree_parent_linked_spans(tmp_path, monkeypatch):
     assert root["span_id"] in parents
     assert parents & ids, "no span parented to another task's span"
     assert any(s["pid"] != root["pid"] for s in runs)
+    # Flow stitching: every execution span's flow_in pairs with a
+    # submit-side span's flow_out (the Perfetto submit->execute arrow).
+    submits = [s for s in spans if s["name"].startswith("submit ")]
+    out_ids = {s["attrs"].get("flow_out") for s in submits}
+    for s in runs:
+        assert s["attrs"].get("flow_in") in out_ids, s

@@ -52,6 +52,13 @@ REQUIRED = {
     "ray_tpu.cgraph.plan",
     "ray_tpu.core.channel",
     "ray_tpu.collective",
+    # The observability layer imports into EVERY runtime process (the
+    # flight recorder is always on; tracing imports it at module load) —
+    # an import-time backend init here would wedge the whole cluster.
+    "ray_tpu.observability",
+    "ray_tpu.observability.flight_recorder",
+    "ray_tpu.observability.perfetto",
+    "ray_tpu.tracing",
 }
 
 
