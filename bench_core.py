@@ -277,6 +277,90 @@ def bench_tracing_overhead_guard(min_time: float) -> None:
     )
 
 
+def bench_chaos_overhead_guard(min_time: float) -> None:
+    """Chaos injection-point overhead guard.
+
+    The injection sites are compiled into the hot paths permanently
+    (worker task exec, channel read/write, collective ops, provider
+    poll) and must be ~free when chaos is DISARMED — the shipped
+    default. Two measurements:
+
+    - a µbench of the disarmed `maybe_inject()` call itself, converted
+      into a per-task fraction (a no-op task crosses a handful of
+      points): must stay under the ISSUE's 1% task-throughput budget;
+    - end-to-end tasks/s disarmed vs armed-with-a-never-matching rule
+      set (two cluster boots — daemons read RAY_TPU_CHAOS from their
+      spawn env). Armed mode is opt-in, so its bound is looser (10%),
+      recorded for round-over-round tracking.
+    """
+    import os
+
+    from ray_tpu import chaos
+
+    # --- disarmed µbench (the cost every task pays, chaos off) ---------
+    chaos.disable()
+    n_calls = 500_000
+    t0 = time.perf_counter()
+    for _ in range(n_calls):
+        chaos.maybe_inject("task.exec", "bench-noop")
+    disarmed_ns = (time.perf_counter() - t0) / n_calls * 1e9
+
+    never_matching = (
+        '[{"point": "task.exec", "action": "raise", '
+        '"match": "__chaos_bench_never__", "times": -1}]'
+    )
+    saved = os.environ.get("RAY_TPU_CHAOS")
+    rates = {}
+    try:
+        for label, env in (("off", None), ("armed", never_matching)):
+            if env is None:
+                os.environ.pop("RAY_TPU_CHAOS", None)
+                chaos.disable()
+            else:
+                os.environ["RAY_TPU_CHAOS"] = env
+                chaos.configure(env)
+            rt.init(num_cpus=8, num_workers=2, object_store_memory=256 << 20)
+            rates[label] = _sync_dispatch_rate(min_time)
+            rt.shutdown()
+    finally:
+        if saved is None:
+            os.environ.pop("RAY_TPU_CHAOS", None)
+        else:
+            os.environ["RAY_TPU_CHAOS"] = saved
+        chaos.disable()
+
+    # A no-op task crosses ~4 injection-point checks end to end (task
+    # exec + the channel/collective sites it could touch); being
+    # conservative here keeps the budget honest for heavier paths.
+    points_per_task = 4
+    disarmed_fraction = points_per_task * disarmed_ns * 1e-9 * rates["off"]
+    armed_ratio = rates["armed"] / rates["off"] if rates["off"] else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "chaos_overhead",
+                "value": round(disarmed_fraction, 5),
+                "unit": "fraction of task time (disarmed points, est.)",
+                "vs_baseline": None,
+                "disarmed_ns_per_check": round(disarmed_ns, 1),
+                "armed_ratio": round(armed_ratio, 3),
+                "off_ops_s": round(rates["off"], 1),
+                "armed_ops_s": round(rates["armed"], 1),
+            }
+        ),
+        flush=True,
+    )
+    assert disarmed_fraction < 0.01, (
+        f"disarmed chaos injection points cost {100 * disarmed_fraction:.2f}% "
+        f"of task throughput (budget: 1%) — {disarmed_ns:.0f} ns/check at "
+        f"{rates['off']:.0f} tasks/s"
+    )
+    assert armed_ratio >= 0.90, (
+        f"armed (non-matching) chaos rules cost {100 * (1 - armed_ratio):.1f}% "
+        f"of task throughput (sanity budget: 10%) — {rates}"
+    )
+
+
 def _store_puts_total() -> float:
     """Cluster-aggregated raytpu_store_puts_total (all processes)."""
     from ray_tpu.utils import state
@@ -532,6 +616,7 @@ def main():
     # Last: a guard failure must not discard the completed run's results.
     bench_overhead_guard(min_time)
     bench_tracing_overhead_guard(min_time)
+    bench_chaos_overhead_guard(min_time)
 
 
 if __name__ == "__main__":

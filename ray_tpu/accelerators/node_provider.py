@@ -26,6 +26,8 @@ import json
 import threading
 from typing import Any, Dict, List, Optional
 
+from ..chaos.controller import maybe_inject as _chaos_inject
+from ..observability.flight_recorder import record as _flight_record
 from .gce import TPU_REST_URL, HttpTransport, gce_access_token
 from .tpu import parse_pod_type
 
@@ -73,6 +75,55 @@ class LocalNodeProvider(NodeProvider):
         self._seq = 0
         # cloud_id -> {"status", "nodes": [node_id...], "labels": {...}}
         self._instances: Dict[str, dict] = {}
+        self._gcs_cli = None
+
+    def _gcs(self):
+        if self._gcs_cli is None:
+            from ..core.rpc import RpcClient
+
+            self._gcs_cli = RpcClient(self._cluster.gcs_sock)
+        return self._gcs_cli
+
+    # ----------------------------------------------------------- preemption
+    def inject_preemption(self, cloud_id: str, deadline_s: float = 1.0) -> bool:
+        """Synthesizes a spot/preemption notice for one instance — the
+        Cloud TPU preemption contract end to end: the notice lands NOW
+        (every host's ray node enters the GCS draining state and
+        `node_draining` is published to subscribers), and the machines
+        actually die at the deadline. The chaos controller drives this
+        via a `provider.poll` rule with action `preempt`; tests and
+        operators can also call it directly."""
+        import time
+
+        with self._lock:
+            rec = self._instances.get(cloud_id)
+            if rec is None or rec["status"] != "running":
+                return False
+            rec["status"] = "preempting"
+            nodes = list(rec["nodes"])
+        _flight_record("chaos.preempt", (cloud_id, deadline_s))
+        for nid in nodes:
+            try:
+                self._gcs().call(
+                    "report_preemption", nid, deadline_s, "spot preemption (injected)"
+                )
+            except Exception:
+                pass  # notice is best-effort, termination is not
+
+        def _terminate():
+            time.sleep(max(0.0, deadline_s))
+            for nid in nodes:
+                try:
+                    self._cluster.remove_node(nid)
+                except Exception:
+                    pass
+            with self._lock:
+                cur = self._instances.get(cloud_id)
+                if cur is not None and cur["status"] == "preempting":
+                    cur["status"] = "gone"
+
+        threading.Thread(target=_terminate, daemon=True).start()
+        return True
 
     def request(self, instance) -> str:
         with self._lock:
@@ -96,6 +147,10 @@ class LocalNodeProvider(NodeProvider):
         tpus = float(shape.get("tpus", 0.0))
         if tpus:
             res["TPU"] = tpus
+        for k, v in (shape.get("resources") or {}).items():
+            # Extra custom resources (chaos/e2e tests pin gangs to
+            # provider-managed nodes with these).
+            res[str(k)] = float(v)
         labels = {"ray_tpu_cloud_id": cloud_id}
         if hosts > 1:
             labels["slice_name"] = cloud_id
@@ -136,12 +191,28 @@ class LocalNodeProvider(NodeProvider):
 
     def poll(self) -> Dict[str, str]:
         with self._lock:
-            return {cid: rec["status"] for cid, rec in self._instances.items()}
+            snapshot = {cid: rec["status"] for cid, rec in self._instances.items()}
+        for cid, status in snapshot.items():
+            # Chaos hook: a `provider.poll` rule with action `preempt`
+            # turns a healthy slice into a preemption casualty — the
+            # deterministic version of a spot reclaim.
+            if status == "running":
+                rule = _chaos_inject("provider.poll", cid)
+                if rule is not None and rule.action == "preempt":
+                    self.inject_preemption(cid, deadline_s=rule.delay_s)
+        # During the grace window the machines are still up; the
+        # reconciler learns of the loss when the ray nodes die.
+        return {
+            cid: ("running" if st == "preempting" else st)
+            for cid, st in snapshot.items()
+        }
 
     def ray_node_for(self, cloud_id: str) -> Optional[str]:
         with self._lock:
             rec = self._instances.get(cloud_id)
-            if rec is None or rec["status"] != "running" or not rec["nodes"]:
+            if rec is None or rec["status"] not in ("running", "preempting"):
+                return None
+            if not rec["nodes"]:
                 return None
             return rec["nodes"][0]
 
@@ -312,7 +383,8 @@ class GceTpuNodeProvider(NodeProvider):
             if node is None:
                 out[cloud_id] = "gone"
                 continue
-            state = self._STATE_MAP.get(node.get("state", ""), "pending")
+            raw_state = node.get("state", "")
+            state = self._STATE_MAP.get(raw_state, "pending")
             if state == "running":
                 endpoints = node.get("networkEndpoints") or []
                 if len(endpoints) < rec["hosts"]:
@@ -321,9 +393,32 @@ class GceTpuNodeProvider(NodeProvider):
                     self._safe_delete(cloud_id)
                     state = "failed"
             elif state == "failed":
+                if raw_state == "PREEMPTED":
+                    # Relay the cloud's preemption as a drain notice so
+                    # gang supervisors hear about it through the same
+                    # `node_events` channel the chaos/local path uses
+                    # (grace 0: by the time the API shows PREEMPTED the
+                    # machine is already gone).
+                    self._notify_preempted(cloud_id)
                 self._safe_delete(cloud_id)
             out[cloud_id] = state
         return out
+
+    def _notify_preempted(self, cloud_id: str) -> None:
+        if self._gcs is None:
+            return
+        try:
+            nodes = self._gcs.call("list_nodes")
+        except Exception:
+            return
+        for n in nodes:
+            if (n.get("Labels") or {}).get("ray_tpu_cloud_id") == cloud_id:
+                try:
+                    self._gcs.call(
+                        "report_preemption", n["NodeID"], 0.0, "cloud preemption"
+                    )
+                except Exception:
+                    pass
 
     def _safe_delete(self, cloud_id: str) -> None:
         try:

@@ -44,12 +44,13 @@ class CompiledRef:
 
     _is_channel_dag_ref = True
 
-    def __init__(self, graph: "CompiledGraph", seq: int):
+    def __init__(self, graph: "CompiledGraph", seq: int, gen: int = 0):
         self._graph = graph
         self._seq = seq
+        self._gen = gen
 
     def get(self, timeout: Optional[float] = None) -> Any:
-        return self._graph._fetch(self._seq, timeout)
+        return self._graph._fetch(self._seq, timeout, gen=self._gen)
 
 
 class CompiledGraph:
@@ -73,6 +74,7 @@ class CompiledGraph:
         capacity: int = 8 << 20,
         max_inflight: int = DEFAULT_MAX_INFLIGHT,
         max_message: int = 0,
+        auto_rebuild: bool = False,
     ):
         if max_inflight is not None and max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
@@ -81,6 +83,8 @@ class CompiledGraph:
             root, self._dag_id, int(capacity), int(max_message)
         )
         self._max_inflight = max_inflight
+        self._auto_rebuild = bool(auto_rebuild)
+        self._gen = 0  # incarnation counter: bumped by every recompile
         self._seq = 0
         self._next_read = 0
         self._buffer: Dict[int, Any] = {}
@@ -108,13 +112,20 @@ class CompiledGraph:
                 "span_id": ctx["span_id"] if ctx else None,
             }
 
-        # ---- wire up: setup (actors host in-edge readers) -> driver
-        # readers -> communicators -> start (actors attach writers + loops)
-        # -> driver writers.
-        specs: Dict[str, Any] = {}
         self._out_readers: List[Tuple[int, ChannelReader]] = []
         self._in_writers: List[Tuple[int, ChannelWriter]] = []
         self._comms: List[TpuCommunicator] = []
+        self._wire()
+
+    def _wire(self) -> None:
+        """Wire up one incarnation: setup (actors host in-edge readers) ->
+        driver readers -> communicators -> start (actors attach writers +
+        loops) -> driver writers. Called at construction and again by
+        recompile() against restarted actors."""
+        specs: Dict[str, Any] = {}
+        self._out_readers = []
+        self._in_writers = []
+        self._comms = []
         set_up: List[Any] = []  # actors whose contexts need undo on failure
         try:
             for a, h in self._handles.items():
@@ -182,6 +193,12 @@ class CompiledGraph:
         return self._seq - self._next_read
 
     def execute(self, *input_values) -> CompiledRef:
+        if self._broken and self._auto_rebuild:
+            # A participating actor died and the graph tore itself down;
+            # with auto-rebuild the next execute() transparently rewires
+            # against the restarted actors (max_restarts must cover the
+            # death, or recompile fails with the actor's death reason).
+            self.recompile()
         if self._torn_down:
             raise RuntimeError("compiled graph was torn down")
         if self._broken:
@@ -216,7 +233,7 @@ class CompiledGraph:
         )
         with span_cm:
             self._write_inputs(by_input)
-        ref = CompiledRef(self, self._seq)
+        ref = CompiledRef(self, self._seq, self._gen)
         self._t0[self._seq] = time.perf_counter()
         self._m_execs.inc()
         self._seq += 1
@@ -237,7 +254,14 @@ class CompiledGraph:
                 if i > 0:
                     # Earlier edges were written: actors are now one
                     # iteration out of step — every future result would be
-                    # silently mispaired. Fail the DAG loudly.
+                    # silently mispaired. Fail the DAG loudly (marking it
+                    # broken, so auto_rebuild graphs recompile on the
+                    # next execute instead of staying dead forever).
+                    self._broken = (
+                        f"compiled graph {self._dag_id[:8]}: input write "
+                        "failed after a partial write; the pipeline is "
+                        "desynchronized"
+                    )
                     self.teardown()
                     raise RuntimeError(
                         "compiled graph input write failed after a partial "
@@ -327,7 +351,15 @@ class CompiledGraph:
         self._buffer[self._next_read] = result
         self._next_read += 1
 
-    def _fetch(self, seq: int, timeout: Optional[float]) -> Any:
+    def _fetch(self, seq: int, timeout: Optional[float], gen: int = 0) -> Any:
+        if gen != self._gen:
+            # The graph was recompiled since this ref was minted: its
+            # iteration died with the previous incarnation's channels.
+            raise ChannelClosed(
+                f"compiled graph {self._dag_id[:8]}: ref from a previous "
+                "incarnation (the graph was recompiled after a failure); "
+                "re-execute to get a fresh ref"
+            )
         while seq not in self._buffer:
             if self._broken and seq >= self._next_read:
                 raise ChannelClosed(self._broken)
@@ -358,6 +390,52 @@ class CompiledGraph:
         for _, r in self._out_readers:
             r.close()
 
+    def recompile(self, timeout: float = 60.0) -> "CompiledGraph":
+        """Rebuilds the graph's data plane against the CURRENT actor
+        incarnations: fresh channels, fresh communicators, fresh exec
+        loops — the same plan, recompiled. This is the recovery path
+        after a participating actor died and was restored by
+        `max_restarts` (PR-4's idempotent teardown already ran, or runs
+        here). Pending CompiledRefs from the previous incarnation raise
+        ChannelClosed on get(); retried wiring waits up to `timeout` for
+        restarting actors to come back."""
+        self.teardown()  # idempotent; usually already ran on the failure
+        deadline = time.monotonic() + timeout
+        last: Optional[BaseException] = None
+        while True:
+            # Reset iteration state: the new incarnation starts at seq 0
+            # (actor-side executors restart their loops from scratch).
+            self._torn_down = False
+            self._broken = None
+            self._seq = 0
+            self._next_read = 0
+            self._buffer.clear()
+            self._partial_round = {}
+            self._t0.clear()
+            try:
+                self._wire()  # cleans up its own partial state on failure
+            except BaseException as e:  # noqa: BLE001
+                self._torn_down = True
+                last = e
+                if time.monotonic() >= deadline:
+                    # Keep _broken set: an auto_rebuild graph must stay
+                    # eligible for another recompile attempt on the next
+                    # execute() (e.g. the actor's restore outlived this
+                    # timeout), not be dead forever.
+                    self._broken = (
+                        f"compiled graph {self._dag_id[:8]}: recompile "
+                        f"failed: {last!r}"
+                    )
+                    raise RuntimeError(
+                        f"compiled graph {self._dag_id[:8]}: recompile failed "
+                        f"after {timeout}s (actors not back?): {last!r}"
+                    ) from last
+                time.sleep(0.25)
+                continue
+            self._gen += 1
+            _frec.record("cgraph.recompile", (self._dag_id[:8], self._gen))
+            return self
+
     def __enter__(self) -> "CompiledGraph":
         return self
 
@@ -372,14 +450,19 @@ def compile(
     buffer_size_bytes: int = 8 << 20,
     max_inflight: int = DEFAULT_MAX_INFLIGHT,
     max_message_bytes: int = 0,
+    auto_rebuild: bool = False,
 ) -> CompiledGraph:
     """Compiles a bound actor-method DAG onto the channel data plane
     (reference: dag.experimental_compile). `buffer_size_bytes` sizes each
     ring; `max_message_bytes` (optional) fails compilation up front if a
-    declared message could not fit; `max_inflight` bounds pipeline depth."""
+    declared message could not fit; `max_inflight` bounds pipeline depth;
+    `auto_rebuild=True` makes execute() transparently recompile() the
+    data plane after a participating actor dies and restarts
+    (max_restarts) instead of raising ChannelClosed forever."""
     return CompiledGraph(
         dag,
         capacity=buffer_size_bytes,
         max_inflight=max_inflight,
         max_message=max_message_bytes,
+        auto_rebuild=auto_rebuild,
     )

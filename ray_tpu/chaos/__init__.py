@@ -1,0 +1,33 @@
+"""Fault-injection subsystem: deterministic chaos for recovery testing.
+
+Usage (driver, before ``ray_tpu.init()`` — the env propagates to every
+daemon and worker)::
+
+    export RAY_TPU_CHAOS='[{"point": "task.exec", "action": "kill",
+                            "match": "train_step", "after": 3, "times": 1}]'
+    export RAY_TPU_CHAOS_SEED=42
+
+or programmatically in one process::
+
+    from ray_tpu import chaos
+    chaos.configure([chaos.ChaosRule(point="chan.write", action="delay",
+                                     delay_s=0.2, times=-1)])
+
+See :mod:`ray_tpu.chaos.controller` for the rule schema and the list of
+injection points, and the README's "Fault tolerance & chaos testing"
+section for the fault model.
+"""
+
+from .controller import (  # noqa: F401
+    ENV_VAR,
+    POINTS,
+    SEED_ENV,
+    ChaosController,
+    ChaosRule,
+    configure,
+    controller,
+    disable,
+    enabled,
+    kill_now,
+    maybe_inject,
+)

@@ -35,10 +35,23 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from . import tracing as _tracing
+from .chaos.controller import maybe_inject as _chaos_inject
+from .exceptions import CollectiveTimeoutError
 from .observability.flight_recorder import record as _flight_record
 
 _LEN = struct.Struct("<Q")
 _KV_PREFIX = "__collective__/"
+
+
+def _rendezvous_timeout() -> float:
+    """Group-establishment deadline (env-tunable: chaos tests shrink it
+    so a missing member surfaces in seconds, not the 60 s default)."""
+    import os
+
+    try:
+        return float(os.environ.get("RAY_TPU_COLLECTIVE_TIMEOUT_S", "") or 60.0)
+    except ValueError:
+        return 60.0
 
 
 def _send_msg(sock: socket.socket, payload: bytes) -> None:
@@ -113,11 +126,49 @@ class _Group:
         self._prev: Optional[socket.socket] = None  # from (rank-1) % ws
         self._lock = threading.Lock()
         if world_size > 1:
+            rule = _chaos_inject("coll.rendezvous", f"{name}:{rank}")
+            if rule is not None and rule.action == "raise":
+                self._fail_rendezvous("chaos: injected rendezvous failure")
             _flight_record("coll.rendezvous", (name, rank, world_size))
             self._establish_ring()
             _flight_record("coll.ring_up", (name, rank))
 
-    def _lookup(self, rank: int, timeout: float = 60.0) -> tuple:
+    def _missing_ranks(self) -> List[int]:
+        """Ranks with no live KV registration — the members a stuck
+        rendezvous is actually waiting on."""
+        out: List[int] = []
+        for r in range(self.world_size):
+            try:
+                if not self._gcs.call("kv_get", f"{_KV_PREFIX}{self.name}/{r}"):
+                    out.append(r)
+            except Exception:
+                return out  # GCS unreachable: report what we know
+        return out
+
+    def _fail_rendezvous(
+        self,
+        detail: str,
+        missing: Optional[List[int]] = None,
+        record: bool = True,
+    ):
+        # `record=False` for intra-retry probes: a 5 s lookup miss that
+        # the establish loop immediately retries is not a timeout, and
+        # stamping it would fill post-mortem dumps with coll.timeout
+        # records for rings that came up fine. Only terminal deadline
+        # paths record.
+        if missing is None:
+            missing = self._missing_ranks()
+        if record:
+            _flight_record("coll.timeout", (self.name, self.rank, tuple(missing)))
+        raise CollectiveTimeoutError(
+            self.name, self.rank, self.world_size, missing=missing, detail=detail
+        )
+
+    def _lookup(
+        self, rank: int, timeout: Optional[float] = None, record: bool = True
+    ) -> tuple:
+        if timeout is None:
+            timeout = _rendezvous_timeout()
         deadline = time.monotonic() + timeout
         key = f"{_KV_PREFIX}{self.name}/{rank}"
         while time.monotonic() < deadline:
@@ -126,15 +177,20 @@ class _Group:
                 host, _, port = raw.decode().rpartition(":")
                 return host, int(port)
             time.sleep(0.05)
-        raise TimeoutError(f"collective group {self.name}: rank {rank} never joined")
+        self._fail_rendezvous(
+            f"rank {rank} never registered within {timeout}s",
+            missing=[rank],
+            record=record,
+        )
 
     def _establish_ring(self) -> None:
         """Connects to next, accepts from prev (order-free via a thread)."""
         accepted: Dict[str, Any] = {}
+        rdv_timeout = _rendezvous_timeout()
 
         def do_accept():
             try:
-                self._srv.settimeout(60.0)
+                self._srv.settimeout(rdv_timeout)
                 conn, _ = self._srv.accept()
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 # Peer announces its rank; the ring only expects prev.
@@ -147,7 +203,7 @@ class _Group:
         t = threading.Thread(target=do_accept, daemon=True)
         t.start()
         next_rank = (self.rank + 1) % self.world_size
-        deadline = time.monotonic() + 60.0
+        deadline = time.monotonic() + rdv_timeout
         last = None
         addr = None
         while time.monotonic() < deadline:
@@ -157,7 +213,9 @@ class _Group:
             # classic stale-rank deadlock. The fresh registration
             # overwrites the key; the next lookup picks it up.
             try:
-                addr = self._lookup(next_rank, timeout=5.0)
+                addr = self._lookup(
+                    next_rank, timeout=min(5.0, rdv_timeout), record=False
+                )
             except TimeoutError as e:
                 last = e
                 continue
@@ -168,13 +226,23 @@ class _Group:
                 last = e
                 time.sleep(0.1)
         else:
-            raise ConnectionError(f"cannot reach next rank at {addr}: {last}")
+            self._fail_rendezvous(f"cannot reach next rank at {addr}: {last}")
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         _send_msg(s, pickle.dumps(self.rank))
         self._next = s
-        t.join(timeout=60.0)
-        if "err" in accepted:
-            raise RuntimeError(f"ring accept failed: {accepted['err']}")
+        t.join(timeout=rdv_timeout)
+        err = accepted.get("err")
+        if isinstance(err, (socket.timeout, TimeoutError)) or (
+            err is None and "rank" not in accepted
+        ):
+            # Nobody dialed our listener before the deadline: the prev
+            # rank is missing/dead — name it instead of a bare timeout.
+            self._fail_rendezvous(
+                f"prev rank {(self.rank - 1) % self.world_size} never connected "
+                f"within {rdv_timeout}s"
+            )
+        if err is not None:
+            raise RuntimeError(f"ring accept failed: {err}")
         if accepted.get("conn") is None:
             raise RuntimeError(
                 f"expected prev rank {(self.rank - 1) % self.world_size}, "
@@ -368,6 +436,17 @@ def _op_span(kind: str, group: "_Group", **attrs):
     record is unconditional (a hang dump's last `coll.op` names the op
     and group a gang member was stuck in); the span is tracing-gated and
     carries rank/world for the timeline."""
+    rule = _chaos_inject("coll.op", f"{kind}:{group.name}:{group.rank}")
+    if rule is not None:
+        if rule.action == "delay":
+            time.sleep(rule.delay_s)
+        elif rule.action == "raise":
+            # Surface as the same failure class a dead ring member
+            # produces, so callers exercise their real recovery path.
+            raise ConnectionError(
+                f"chaos: injected collective fault in {kind} on group "
+                f"{group.name!r} rank {group.rank}"
+            )
     _flight_record("coll.op", (kind, group.name, group.rank))
     return _tracing.maybe_span(
         f"collective.{kind}",

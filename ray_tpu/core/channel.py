@@ -36,7 +36,28 @@ import time
 import uuid
 from typing import Any, Optional
 
+from ..chaos.controller import maybe_inject as _chaos_inject
 from ..observability.flight_recorder import record as _flight_record
+
+
+def _apply_channel_chaos(point: str, name: str) -> bool:
+    """Chaos hook shared by reader and writer. Returns True when the
+    message must be DROPPED (writer only); `delay` sleeps here; `raise`
+    surfaces as ChannelClosed — the same exception a dead peer produces,
+    so recovery paths are exercised, not special-cased. Disabled cost:
+    one global load inside maybe_inject."""
+    rule = _chaos_inject(point, name)
+    if rule is None:
+        return False
+    if rule.action == "delay":
+        time.sleep(rule.delay_s)
+        return False
+    if rule.action == "drop":
+        _flight_record("chan.chaos_drop", name)
+        return True
+    if rule.action == "raise":
+        raise ChannelClosed(f"{name} (chaos: injected channel fault)")
+    return False
 
 _HDR = struct.Struct("<QQII")  # write_pos, read_pos, reader_closed, writer_closed
 _LEN = struct.Struct("<I")
@@ -284,6 +305,7 @@ class ChannelReader:
             raise ChannelClosed(self.name)
         if self._conn is None and self._stream is None:
             self._accept(timeout)
+        _apply_channel_chaos("chan.read", self.name)
         # Flight-recorder bracket: a `chan.read_wait` with no matching
         # `chan.read` in a hang dump names the blocked channel.
         _flight_record("chan.read_wait", self.name)
@@ -424,6 +446,8 @@ class ChannelWriter:
     def write_bytes(self, payload: bytes, timeout: Optional[float] = None) -> None:
         if self._closed:
             raise ChannelClosed(self.spec.name)
+        if _apply_channel_chaos("chan.write", self.spec.name):
+            return  # injected message drop: the bytes never hit the wire
         _flight_record("chan.write_wait", self.spec.name)
         try:
             self._write_bytes_inner(payload, timeout)

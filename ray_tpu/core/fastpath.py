@@ -490,11 +490,19 @@ class ActorChannel:
                     dsock = None
                 if dsock and os.path.exists(dsock):
                     try:
+                        # Short per-attempt timeout: right after a worker
+                        # death this dsock can be the DEAD incarnation's
+                        # still-on-disk socket (the GCS/raylet records go
+                        # stale for one monitor tick), and a long blind
+                        # connect burns the whole window refusing. The
+                        # loop re-resolves fresh state each pass, so a
+                        # legitimately slow boot just reconnects next
+                        # round (measured: actor restore 7 s -> 2.5 s).
                         conn = DirectConn(
                             dsock,
                             f"actor-{self.aid[:8]}",
                             self._on_conn_dead,
-                            connect_timeout=5.0,
+                            connect_timeout=1.0,
                             on_sealed=self._rt._fast_sealed,
                         )
                     except ConnectionError:

@@ -267,6 +267,7 @@ class GcsService:
         return {"ok": True, "nodes": n_alive}
 
     def heartbeat(self, node_id: str, available: dict, stats: Optional[dict] = None) -> dict:
+        raylet_drained = False
         with self._lock:
             n = self._nodes.get(node_id)
             alive = sum(1 for m in self._nodes.values() if m["alive"])
@@ -275,11 +276,70 @@ class GcsService:
             n["available"] = dict(available)
             if stats:
                 n["stats"] = dict(stats)
+                if stats.get("draining") and not n.get("draining"):
+                    raylet_drained = True
             n["last_hb"] = time.monotonic()
             if not n["alive"]:
                 n["alive"] = True
                 alive += 1
+        if raylet_drained:
+            # Raylet-initiated drain (chaos/local admin): adopt it through
+            # the same path as a GCS-initiated one so scheduling exclusion,
+            # subscriber notification, persistence, and the drained
+            # counter all fire identically.
+            self.report_preemption(node_id, 0.0, "raylet-initiated drain")
         return {"ok": True, "nodes": alive}
+
+    # ---------------------------------------------------- preemption/drain
+    def report_preemption(
+        self, node_id: str, deadline_s: float = 30.0, reason: str = "preempted"
+    ) -> bool:
+        """A preemption notice for `node_id` (synthesized by chaos / the
+        local provider, or relayed from the cloud's metadata server by a
+        real one). The node enters the DRAINING state: it stays alive and
+        keeps executing in-flight work, but new placement avoids it, its
+        raylet stops granting leases, and `node_draining` is published on
+        the `node_events` pubsub channel so gang supervisors (train,
+        serve, cgraph drivers) can checkpoint/replace before the machine
+        actually dies at the deadline."""
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n is None:
+                return False
+            already = bool(n.get("draining"))
+            n["draining"] = True
+            n["drain_reason"] = reason
+            n["drain_deadline"] = time.time() + max(0.0, deadline_s)
+            self._persist_delta("_nodes", node_id, n)
+            sock = n["sock"] if n["alive"] else None
+        if already:
+            return True
+        imet.NODES_DRAINED.inc()
+        from ..observability.flight_recorder import record as _frec_record
+
+        _frec_record("node.drain_notice", (node_id[:12], deadline_s, reason))
+        self._announce_draining(node_id, deadline_s, reason)
+        # Flip the raylet into drain mode (best-effort: on a real
+        # preemption the machine may already be unreachable — the pubsub
+        # notice above is the part subscribers can rely on).
+        if sock:
+            try:
+                self._raylet_call(sock, "drain", deadline_s)
+            except Exception:
+                pass
+        return True
+
+    def _announce_draining(self, node_id: str, deadline_s: float, reason: str) -> None:
+        self.pubsub_publish(
+            "node_events",
+            {
+                "event": "node_draining",
+                "node_id": node_id,
+                "deadline_s": deadline_s,
+                "reason": reason,
+                "ts": time.time(),
+            },
+        )
 
     def drain_node(self, node_id: str) -> bool:
         with self._lock:
@@ -296,6 +356,9 @@ class GcsService:
                 {"NodeID": nid, "Alive": n["alive"], "Resources": dict(n["resources"]),
                  "Available": dict(n["available"]), "Labels": dict(n.get("labels") or {}),
                  "Stats": dict(n.get("stats") or {}),
+                 "Draining": bool(n.get("draining")),
+                 "DrainReason": n.get("drain_reason"),
+                 "DrainDeadline": n.get("drain_deadline"),
                  "sock": n["sock"], "store": n["store"]}
                 for nid, n in self._nodes.items()
             ]
@@ -488,7 +551,9 @@ class GcsService:
             best = None
             best_used = -1.0
             for nid, n in sorted(self._nodes.items()):
-                if nid in exclude or not n["alive"]:
+                if nid in exclude or not n["alive"] or n.get("draining"):
+                    # A draining node is leaving: placing new work there
+                    # would lose it at the preemption deadline.
                     continue
                 avail = n["available"]
                 if all(avail.get(k, 0.0) >= v for k, v in resources.items()):
@@ -539,6 +604,12 @@ class GcsService:
         become restart candidates (reference: gcs_node_manager death
         handling -> gcs_actor_manager restart :548); SLICE_GANG groups with
         a member on the dead node co-fail and reschedule atomically."""
+        # Death is also a node_event: supervisors subscribed for drain
+        # notices learn about un-noticed failures from the same stream.
+        self.pubsub_publish(
+            "node_events",
+            {"event": "node_dead", "node_id": node_id, "ts": time.time()},
+        )
         gangs: List[str] = []
         with self._lock:
             for pg_id, pg in self._pgs.items():
@@ -664,6 +735,7 @@ class GcsService:
                             {"node_id": nid, "sock": n["sock"], "store": n["store"]}
                             for nid, n in sorted(self._nodes.items())
                             if n["alive"]
+                            and not n.get("draining")
                             and all(
                                 n["resources"].get(k, 0.0) >= v
                                 for k, v in resources.items()
@@ -732,6 +804,7 @@ class GcsService:
                 return {"restart": False}
             a["num_restarts"] += 1
             a["state"] = "RESTARTING"
+            imet.ACTOR_RESTARTS.inc()
             self._persist_delta("_actors", actor_id, a)
             resources = dict(a["resources"])
             pg_id = a.get("pg_id")
@@ -1033,7 +1106,7 @@ class GcsService:
             avail = {
                 nid: dict(n["available"])
                 for nid, n in self._nodes.items()
-                if n["alive"] and nid not in banned
+                if n["alive"] and nid not in banned and not n.get("draining")
             }
         order = sorted(avail, key=lambda nid: -sum(avail[nid].values()))
 
@@ -1083,7 +1156,7 @@ class GcsService:
         with self._lock:
             slices: Dict[str, List[Tuple[int, str, dict]]] = {}
             for nid, n in self._nodes.items():
-                if not n["alive"] or nid in banned:
+                if not n["alive"] or nid in banned or n.get("draining"):
                     continue
                 sl = (n.get("labels") or {}).get("slice_name")
                 if not sl:

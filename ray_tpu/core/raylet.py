@@ -31,6 +31,8 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .. import exceptions as exc
 from .. import tracing as _tracing
+from ..chaos.controller import kill_now as _chaos_kill
+from ..chaos.controller import maybe_inject as _chaos_inject
 from ..observability.flight_recorder import record as _flight_record
 from ..utils import internal_metrics as imet
 from ..utils.config import CONFIG
@@ -169,6 +171,10 @@ class RayletService:
 
         self._remote_raylets: Dict[str, RpcClient] = {}
         self._stop = threading.Event()
+        # Drain state (preemption notice received): new default-placement
+        # work and lease grants are shed to other nodes while in-flight +
+        # gang-pinned work finishes in the grace window.
+        self._draining = False
 
         # Worker zygote: a pre-warmed single-threaded forker that cuts the
         # ~2 s interpreter+jax startup of every fresh worker to a ~10 ms
@@ -525,6 +531,16 @@ class RayletService:
         if not forwarded:
             strategy = entry.get("strategy") or "DEFAULT"
             affinity = decode_node_affinity(strategy)
+            if self._draining and affinity is None:
+                # Draining (preemption notice): fresh default-placement
+                # work must land on a node that will outlive the grace
+                # window (explicitly node-pinned tasks keep their pin).
+                # The placement thread excludes this node and fails the
+                # task visibly if the cluster has no room.
+                threading.Thread(
+                    target=self._place_elsewhere, args=(entry, blob()), daemon=True
+                ).start()
+                return entry["return_ids"]
             if affinity is not None:
                 # NodeAffinity (reference: scheduling_strategies.py
                 # NodeAffinitySchedulingStrategy): route to the named node;
@@ -1263,6 +1279,17 @@ class RayletService:
         normal_task_submitter.cc:354 RequestWorkerLease + the cached lease
         reuse at :555)."""
         resources = dict(resources or {"CPU": 1.0})
+        if self._draining:
+            # Draining node: shed fastpath owners toward a surviving node
+            # (they fall back to raylet-mediated submission if the
+            # cluster has nowhere else to lease).
+            try:
+                target = self.gcs.call("pick_node", resources, [self.node_id])
+            except Exception:
+                target = None
+            if target is not None and target["node_id"] != self.node_id:
+                return {"spill": target["sock"]}
+            return {"retry": True}
         if not self._fits_total(resources):
             try:
                 target = self.gcs.call("pick_node", resources, [self.node_id])
@@ -2057,6 +2084,7 @@ class RayletService:
                         # TaskManager there; here the raylet re-queues since
                         # the deps are still local).
                         entry["attempt"] = entry.get("attempt", 0) + 1
+                        imet.TASKS_RETRIED.inc()
                         self._task_event(
                             entry["task_id"], "QUEUED", retry=entry["attempt"]
                         )
@@ -2120,6 +2148,14 @@ class RayletService:
     # ---------------------------------------------------------- lifecycle
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(CONFIG.heartbeat_interval_s):
+            rule = _chaos_inject("raylet.heartbeat", self.node_id)
+            if rule is not None and rule.action == "kill":
+                # Whole-node crash: SIGKILL the raylet daemon. Workers
+                # orphan (their poll loop exits on raylet loss), the GCS
+                # health loop expires the node, and gang reschedule /
+                # autoscaler replacement take over — the un-noticed half
+                # of the preemption story.
+                _chaos_kill("raylet.heartbeat", self.node_id)
             with self._res_lock:
                 avail = dict(self.available)
             with self._workers_lock:
@@ -2139,6 +2175,11 @@ class RayletService:
                 "num_spilled": n_spilled,
                 "num_workers": n_workers,
             }
+            if self._draining:
+                # Propagate raylet-initiated drains (chaos, local admin)
+                # into the GCS node record; GCS-initiated drains already
+                # set it there first.
+                stats["draining"] = True
             try:
                 reply = self.gcs.call("heartbeat", self.node_id, avail, stats)
                 if isinstance(reply, dict):
@@ -2160,6 +2201,22 @@ class RayletService:
 
     def ping(self) -> str:
         return "pong"
+
+    def drain(self, deadline_s: float = 30.0) -> bool:
+        """Preemption-notice handling (reference: the DrainNode RPC,
+        gcs_node_manager drain path): flips this node into drain state —
+        new default-placement tasks are placed elsewhere, worker-lease
+        requests spill to surviving nodes — while in-flight and
+        bundle-pinned work keeps running through the grace window (gang
+        supervisors own their members' checkpoint/stop). Idempotent."""
+        if not self._draining:
+            self._draining = True
+            _flight_record("node.drain", (self.node_id[:12], deadline_s))
+        self._sched_wake.set()
+        return True
+
+    def is_draining(self) -> bool:
+        return self._draining
 
     def node_resources(self) -> Tuple[Dict[str, float], Dict[str, float]]:
         with self._res_lock:

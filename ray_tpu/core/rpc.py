@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import random
 import socket
 import socketserver
 import struct
@@ -21,6 +22,8 @@ import threading
 import time
 import uuid
 from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..exceptions import RpcUnavailableError
 
 _HDR = struct.Struct("<I")
 # First frame of an authenticated TCP connection: RTPUAUTH:<token>.
@@ -204,20 +207,38 @@ class RpcClient:
     of the reference's retryable gRPC client
     (src/ray/rpc/retryable_grpc_client.h)."""
 
+    # Reconnect backoff shape: a flat fast phase (a daemon mid-boot or
+    # mid-restart usually listens within a second — 20 ms granularity
+    # keeps cluster boots fast), then doubling to a bounded cap so a
+    # long outage costs a handful of connects per second instead of
+    # fifty.
+    _BACKOFF_BASE_S = 0.02
+    _BACKOFF_CAP_S = 1.0
+    _FAST_ATTEMPTS = 50  # ~1 s of 20 ms retries before backing off
+
     def __init__(self, path: str, connect_timeout: float = 20.0):
         self.path = path
         self._connect_timeout = connect_timeout
         self._tls = threading.local()
         self._all: list = []
         self._all_lock = threading.Lock()
+        self._rng = random.Random()
         # Fail fast if the server is absent at construction.
         self._get_sock()
 
     def _new_sock(self, timeout: float) -> socket.socket:
+        """Connects with exponential backoff + full jitter until
+        `timeout`, then raises a typed RpcUnavailableError (a
+        ConnectionError subclass — existing transport handlers keep
+        working). Jitter decorrelates a fleet of clients reconnecting to
+        a restarting GCS/raylet: the old fixed 50 ms cadence made every
+        waiter stampede the listen backlog in lockstep."""
         kind, target = parse_address(self.path)
-        deadline = time.monotonic() + timeout
+        start = time.monotonic()
+        deadline = start + timeout
         last_err: Optional[Exception] = None
-        while time.monotonic() < deadline:
+        attempt = 0
+        while True:
             try:
                 if kind == "tcp":
                     s = socket.create_connection(target, timeout=10.0)
@@ -234,8 +255,30 @@ class RpcClient:
                 return s
             except OSError as e:
                 last_err = e
-                time.sleep(0.05)
-        raise ConnectionError(f"cannot connect to {self.path}: {last_err}")
+                attempt += 1
+            now = time.monotonic()
+            if now >= deadline:
+                raise RpcUnavailableError(
+                    self.path, now - start, attempt, last_err
+                )
+            if attempt <= self._FAST_ATTEMPTS:
+                # Fast phase: the common wait is a daemon that is booting
+                # right now; fine-grained retries keep that latency low.
+                sleep = self._BACKOFF_BASE_S
+            else:
+                # Outage phase: exponential growth with full jitter —
+                # jitter decorrelates a fleet of clients reconnecting to
+                # a restarting GCS/raylet so they don't stampede the
+                # listen backlog in lockstep (never a zero sleep:
+                # connect() on a dead UDS fails in microseconds and
+                # would otherwise busy-spin).
+                cap = min(
+                    self._BACKOFF_CAP_S,
+                    self._BACKOFF_BASE_S
+                    * (2 ** min(attempt - self._FAST_ATTEMPTS, 16)),
+                )
+                sleep = max(0.001, self._rng.uniform(0, cap))
+            time.sleep(min(sleep, deadline - now))
 
     def _get_sock(self) -> socket.socket:
         sock = getattr(self._tls, "sock", None)

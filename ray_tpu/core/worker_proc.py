@@ -33,6 +33,8 @@ from typing import Any, Dict, List, Optional
 
 import cloudpickle
 
+from ..chaos.controller import kill_now as _chaos_kill
+from ..chaos.controller import maybe_inject as _chaos_inject
 from .ids import ActorID, ObjectID
 from .task_spec import GLOBAL_FUNCTION_TABLE
 
@@ -390,6 +392,29 @@ def main(argv: List[str]) -> None:
         _fr("task.exec", (kind, (entry.get("task_id") or "")[:16]))
         token = set_task_context(entry.get("task_id"), entry.get("actor_id"))
         try:
+            # Chaos hook: kill this worker mid-task (SIGKILL — the
+            # monitor loop sees an unexplained death, exactly like an
+            # OOM/preemption), fail the task, or stall it. The detail is
+            # "<desc>@<attempt>" so a rule can target one function
+            # (match "flaky") or one attempt (match "flaky@0" — kills
+            # the first execution everywhere while every retry, which
+            # may land in a fresh worker process with fresh per-process
+            # rule counters, survives deterministically).
+            rule = _chaos_inject(
+                "task.exec",
+                f"{entry.get('desc') or kind}@{entry.get('attempt', 0)}",
+            )
+            if rule is not None:
+                if rule.action == "kill":
+                    _chaos_kill("task.exec", entry.get("desc", ""))
+                elif rule.action == "delay":
+                    import time as _t
+
+                    _t.sleep(rule.delay_s)
+                elif rule.action == "raise":
+                    raise RuntimeError(
+                        f"chaos: injected task failure in {entry.get('desc', kind)}"
+                    )
             # Execution span parented to the submitter's span via the
             # propagated context (reference: tracing_helper.py:92 —
             # _span_wrapper around task execution).

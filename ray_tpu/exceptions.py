@@ -72,6 +72,64 @@ class WorkerCrashedError(RayTpuError):
     pass
 
 
+class RpcUnavailableError(RayTpuError, ConnectionError):
+    """A control-plane peer (GCS/raylet) stayed unreachable past the
+    reconnect deadline. Subclasses ConnectionError so existing transport
+    handlers keep catching it; carries enough context to say WHO was
+    unreachable for HOW long."""
+
+    def __init__(self, address: str = "", elapsed_s: float = 0.0, attempts: int = 0,
+                 last_error: Optional[BaseException] = None):
+        self.address = address
+        self.elapsed_s = elapsed_s
+        self.attempts = attempts
+        self.last_error = last_error
+        super().__init__(
+            f"rpc peer {address} unavailable after {elapsed_s:.1f}s "
+            f"({attempts} connect attempts): {last_error!r}"
+        )
+
+
+class CollectiveTimeoutError(RayTpuError, TimeoutError):
+    """A collective rendezvous (or ring establishment) exceeded its
+    deadline. Names the group, this member's rank, and which ranks never
+    registered — the difference between "socket timeout" and an
+    actionable gang post-mortem."""
+
+    def __init__(
+        self,
+        group: str = "",
+        rank: int = -1,
+        world_size: int = 0,
+        missing: Optional[list] = None,
+        detail: str = "",
+    ):
+        self.group = group
+        self.rank = rank
+        self.world_size = world_size
+        self.missing = sorted(missing or [])
+        miss = (
+            f"; ranks never joined: {self.missing}" if self.missing else ""
+        )
+        super().__init__(
+            f"collective group {group!r} (rank {rank}/{world_size}) "
+            f"rendezvous timed out{miss}"
+            + (f" — {detail}" if detail else "")
+        )
+
+
+class PreemptionError(RayTpuError):
+    """A gang lost capacity to a (possibly synthetic) preemption notice:
+    the node drained, workers checkpointed and stopped. Supervisors catch
+    this to restore on replacement capacity instead of counting it as a
+    training failure."""
+
+    def __init__(self, node_ids: Optional[list] = None, reason: str = "preempted"):
+        self.node_ids = list(node_ids or [])
+        nodes = ", ".join(n[:12] for n in self.node_ids) or "?"
+        super().__init__(f"gang preempted (node(s) {nodes} draining): {reason}")
+
+
 class TaskCancelledError(RayTpuError):
     pass
 

@@ -166,6 +166,8 @@ def cmd_status(args) -> None:
     print(f"nodes alive: {stats['nodes_alive']}")
     for n in state.list_nodes():
         mark = "up" if n["Alive"] else "DOWN"
+        if n["Alive"] and n.get("Draining"):
+            mark = "DRAINING"  # preemption notice received; node departing
         labels = n.get("Labels") or {}
         slice_info = ""
         if labels.get("slice_name"):
@@ -189,6 +191,29 @@ def cmd_status(args) -> None:
         f"object store: {s['num_objects']} objects, "
         f"{s['bytes_in_use'] / (1 << 20):.1f} MiB in use, {s['num_spilled']} spilled"
     )
+    # Recovery counters: has this cluster actually been surviving
+    # failures? (actor restarts, task retries, drains, restores — plus
+    # chaos injections when a fault campaign is armed.)
+    recovery = {
+        "raytpu_actor_restarts_total": "actor_restarts",
+        "raytpu_tasks_retried_total": "tasks_retried",
+        "raytpu_nodes_drained_total": "nodes_drained",
+        "raytpu_checkpoints_restored_total": "checkpoints_restored",
+        "raytpu_chaos_injections_total": "chaos_injections",
+    }
+    totals = {label: 0.0 for label in recovery.values()}
+    try:
+        for m in state.internal_metrics():
+            label = recovery.get(m.get("name"))
+            if label:
+                totals[label] += float(m.get("value") or 0.0)
+    except Exception:
+        totals = {}
+    if totals:
+        print(
+            "recovery: "
+            + " ".join(f"{k}={int(v)}" for k, v in totals.items())
+        )
 
 
 _CLUSTER_STATE_DIR = os.path.expanduser("~/.ray_tpu/clusters")

@@ -286,6 +286,21 @@ class ServeController:
         self._version = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # Preemption awareness: subscribe to node_draining notices so
+        # replicas on a departing node are REPLACED (and de-routed)
+        # before the machine dies, instead of discovered dead afterward.
+        self._node_watcher = None
+        self._handled_draining: set = set()
+        self._drain_thread: Optional[threading.Thread] = None
+        try:
+            from ..core import runtime_base
+            from ..utils.node_events import NodeEventWatcher
+
+            gcs = getattr(runtime_base.current_runtime(), "_gcs", None)
+            if gcs is not None:
+                self._node_watcher = NodeEventWatcher(gcs)
+        except Exception:
+            self._node_watcher = None
         self._loop = threading.Thread(target=self._control_loop, daemon=True)
         self._loop.start()
         self._last_scale_action: Dict[str, float] = {}
@@ -436,10 +451,137 @@ class ServeController:
     def _control_loop(self) -> None:
         while not self._stop.wait(0.25):
             try:
+                self._kick_drain_replacement()
                 self._autoscale()
                 self._reconcile()
             except Exception:
                 pass
+
+    # ---------------------------------------------------- preemption drain
+    def _kick_drain_replacement(self) -> None:
+        """Runs the (potentially slow: replacement construction + health
+        checks) drain migration in its own thread so a capacity-starved
+        replacement cannot stall autoscaling/reconciliation for every
+        other app. At most one migration pass in flight."""
+        watcher = self._node_watcher
+        if watcher is None:
+            return
+        if not (watcher.draining_nodes() - self._handled_draining):
+            return
+        t = self._drain_thread
+        if t is not None and t.is_alive():
+            return
+        self._drain_thread = threading.Thread(
+            target=self._replace_draining_replicas, daemon=True
+        )
+        self._drain_thread.start()
+
+    def _replica_nodes(self) -> Dict[str, str]:
+        """actor_id(hex) -> node_id for every actor in the cluster."""
+        from ..core import runtime_base
+        from ..utils.node_events import actor_locations
+
+        gcs = getattr(runtime_base.current_runtime(), "_gcs", None)
+        return actor_locations(gcs) if gcs is not None else {}
+
+    def _replace_draining_replicas(self) -> None:
+        """Preemption reaction (reference: deployment_state's
+        drain-node replica migration): for every replica hosted on a
+        DRAINING node, build its replacement FIRST (the GCS placer
+        already excludes draining nodes), publish the swapped replica
+        list so routers move new traffic over, and only then gracefully
+        drain-kill the old replica — the old one keeps accepting until
+        the replacement is routable."""
+        watcher = self._node_watcher
+        if watcher is None:
+            return
+        draining = watcher.draining_nodes() - self._handled_draining
+        if not draining:
+            return
+        locations = self._replica_nodes()
+        if not locations:
+            return
+        from ..observability.flight_recorder import record as _frec_record
+
+        with self._lock:
+            apps = dict(self._apps)
+            gens = dict(self._app_gen)
+        handled_any = True
+        for name, spec in apps.items():
+            with self._lock:
+                current = list(self._replicas.get(name, []))
+            victims = [
+                r
+                for r in current
+                if locations.get(r._actor_id.hex()) in draining
+            ]
+            if not victims:
+                continue
+            _frec_record(
+                "serve.drain_replace", (name, len(victims), tuple(sorted(draining))[:4])
+            )
+            opts = {"max_concurrency": spec["max_ongoing"], **spec["actor_options"]}
+            replica_cls = api.remote(**opts)(Replica)
+            replacements = []
+            try:
+                for _ in victims:
+                    replacements.append(
+                        replica_cls.remote(
+                            spec["cls_blob"],
+                            spec["init_args"],
+                            spec["init_kwargs"],
+                            name,
+                        )
+                    )
+                # Replacements must be CONSTRUCTED before the victims are
+                # de-routed: a router switching to a still-booting replica
+                # would stall requests the old replica could have served.
+                api.get([r.health_check.remote() for r in replacements], timeout=60)
+            except Exception:
+                for r in replacements:
+                    try:
+                        api.kill(r)
+                    except Exception:
+                        pass
+                handled_any = False  # no capacity yet: retry next tick
+                continue
+            with self._lock:
+                stale = (
+                    self._app_gen.get(name, 0) != gens.get(name, 0)
+                    or name not in self._apps
+                )
+                if not stale:
+                    # Recompute against the LIVE list under the lock, not
+                    # the pre-health-check snapshot: autoscale/reconcile
+                    # kept ticking while replacements booted, and a swap
+                    # based on the stale snapshot would silently drop (and
+                    # leak) any replica they added in between.
+                    survivors = [
+                        r
+                        for r in self._replicas.get(name, [])
+                        if r not in victims
+                    ] + replacements
+                    self._replicas[name] = survivors
+                    # Bump the app generation: an in-flight reconcile
+                    # pass that snapshotted the pre-swap list must
+                    # discard at its write-back (its stale-guard), not
+                    # resurrect the drain-killed victims.
+                    self._app_gen[name] = self._app_gen.get(name, 0) + 1
+                    self._version += 1
+            if stale:
+                for r in replacements:
+                    try:
+                        api.kill(r)
+                    except Exception:
+                        pass
+                continue
+            # Old replicas finish their in-flight work, then die.
+            for victim in victims:
+                threading.Thread(
+                    target=self._drain_then_kill, args=(victim,), daemon=True
+                ).start()
+        if handled_any:
+            self._handled_draining |= draining
 
     # ---------------------------------------------------------- autoscale
     def _autoscale(self) -> None:
@@ -496,6 +638,8 @@ class ServeController:
 
     def shutdown(self) -> bool:
         self._stop.set()
+        if self._node_watcher is not None:
+            self._node_watcher.stop()
         for name in list(self._replicas):
             self.delete_app(name)
         return True

@@ -7,7 +7,7 @@ groups; in-program psum replaces DDP allreduce.
 
 from .checkpoint import Checkpoint, CheckpointManager, StorageContext, load_pytree, save_pytree
 from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
-from .session import get_checkpoint, get_context, get_session, report
+from .session import drain_requested, get_checkpoint, get_context, get_session, report
 from .trainer import JaxTrainer, Result
 from .worker_group import WorkerGroup
 
@@ -23,6 +23,6 @@ def get_mesh():
 __all__ = [
     "Checkpoint", "CheckpointManager", "StorageContext", "load_pytree",
     "save_pytree", "CheckpointConfig", "FailureConfig", "RunConfig",
-    "ScalingConfig", "get_checkpoint", "get_context", "get_session",
-    "report", "JaxTrainer", "Result", "WorkerGroup", "get_mesh",
+    "ScalingConfig", "drain_requested", "get_checkpoint", "get_context",
+    "get_session", "report", "JaxTrainer", "Result", "WorkerGroup", "get_mesh",
 ]

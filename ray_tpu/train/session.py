@@ -50,6 +50,7 @@ class TrainSession:
         self._result_queue: "queue.Queue" = queue.Queue(maxsize=1)
         self._finished = threading.Event()
         self._cancelled = threading.Event()
+        self._drain = threading.Event()
         self._last_report_ts: Optional[float] = None
 
     # ------------------------------------------------------------ user API
@@ -92,6 +93,18 @@ class TrainSession:
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self._starting_checkpoint
+
+    def drain_requested(self) -> bool:
+        """True once the gang's node received a preemption notice. A
+        cooperative training loop checks this each step and reacts with a
+        final `report(metrics, checkpoint=...)` then returns — the
+        drain -> checkpoint half of preemption recovery. Loops that never
+        check still recover (the trainer falls back to the periodic
+        checkpoint), they just lose the steps since it."""
+        return self._drain.is_set()
+
+    def request_drain(self) -> None:
+        self._drain.set()
 
     # ------------------------------------------------------ coordinator API
     def next_result(self, timeout: Optional[float] = None) -> Optional[Dict[str, Any]]:
@@ -175,6 +188,13 @@ def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None) -> 
 def get_checkpoint() -> Optional[Checkpoint]:
     s = get_session()
     return s.get_checkpoint() if s else None
+
+
+def drain_requested() -> bool:
+    """Whether this worker's node is draining (preemption notice). See
+    TrainSession.drain_requested."""
+    s = get_session()
+    return s.drain_requested() if s else False
 
 
 class TrainContext:

@@ -20,13 +20,27 @@ from __future__ import annotations
 import dataclasses
 import time
 import uuid
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Set
 
 from .. import api
+from .. import exceptions as exc
+from ..core import runtime_base
 from ..core.placement_group import placement_group as create_pg
+from ..observability.flight_recorder import record as _flight_record
+from ..utils import internal_metrics as imet
+from ..utils import node_events
+from ..utils.node_events import NodeEventWatcher
 from .checkpoint import Checkpoint, CheckpointManager, StorageContext
 from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
 from .worker_group import WorkerGroup
+
+# Preemptions are capacity events, not training failures: they retry on
+# their own (bounded) budget instead of burning FailureConfig.max_failures.
+MAX_PREEMPTION_RETRIES = 16
+# How long fit() waits for replacement capacity after a preemption before
+# letting the next attempt fail on its own (the autoscaler's replace loop
+# normally lands a slice well inside this).
+CAPACITY_WAIT_S = 120.0
 
 
 @dataclasses.dataclass
@@ -93,6 +107,7 @@ class JaxTrainer:
         )
         max_failures = self.run_config.failure_config.max_failures
         attempt = 0
+        preemptions = 0
         resume_ckpt = self._resume_from
         last_error: Optional[BaseException] = None
         metrics: Dict[str, Any] = {}
@@ -104,6 +119,25 @@ class JaxTrainer:
                 break
             except (KeyboardInterrupt, SystemExit):
                 raise  # user abort is not a training failure
+            except exc.PreemptionError as e:
+                # A preemption notice drained the gang: this is a
+                # capacity event, not a training failure — restore on the
+                # replacement slice without burning max_failures
+                # (bounded by its own budget so a flapping cluster still
+                # terminates).
+                last_error = e
+                metrics = getattr(self, "_last_metrics", {})
+                preemptions += 1
+                resume_ckpt = manager.latest_checkpoint or resume_ckpt
+                if preemptions > MAX_PREEMPTION_RETRIES:
+                    break
+                if resume_ckpt is not None:
+                    imet.CHECKPOINTS_RESTORED.inc()
+                _flight_record(
+                    "train.restore",
+                    (resume_ckpt.path if resume_ckpt else None, preemptions),
+                )
+                self._wait_for_capacity()
             except Exception as e:  # noqa: BLE001
                 last_error = e
                 metrics = getattr(self, "_last_metrics", {})
@@ -113,6 +147,9 @@ class JaxTrainer:
                 resume_ckpt = manager.latest_checkpoint or resume_ckpt
                 if max_failures >= 0 and attempt > max_failures:
                     break
+                if resume_ckpt is not None:
+                    imet.CHECKPOINTS_RESTORED.inc()
+                    _flight_record("train.restore", (resume_ckpt.path, attempt))
 
         storage.write_json(
             "result.json",
@@ -124,6 +161,41 @@ class JaxTrainer:
             path=storage.trial_dir,
             error=last_error,
         )
+
+    def _wait_for_capacity(self, timeout_s: float = CAPACITY_WAIT_S) -> bool:
+        """Blocks until some alive, non-draining node could EVER host one
+        worker (total capacity, not current availability) — the restore
+        attempt after a preemption should start once the autoscaler's
+        replacement arrives, not burn retries against an empty cluster."""
+        need = dict(self.scaling_config.resources_per_worker or {"CPU": 1.0})
+        rt = runtime_base.current_runtime()
+        if getattr(rt, "_gcs", None) is None:
+            return True  # local mode: nothing to wait for
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                nodes = rt.nodes()
+            except Exception:
+                nodes = []
+            for n in nodes:
+                if not n.get("Alive") or n.get("Draining"):
+                    continue
+                total = n.get("Resources") or {}
+                if all(total.get(k, 0.0) >= v for k, v in need.items()):
+                    return True
+            time.sleep(0.25)
+        return False
+
+    @staticmethod
+    def _gang_nodes(gcs, group: WorkerGroup) -> Set[str]:
+        """The node ids currently hosting the gang's worker actors."""
+        ids = {w._actor_id.hex() for w in group.workers}
+        locations = node_events.actor_locations(gcs)
+        return {
+            nid
+            for aid, nid in locations.items()
+            if aid in ids and nid
+        }
 
     def _use_distributed(self) -> bool:
         """Multi-host rendezvous requires process-isolated workers (one jax
@@ -158,6 +230,19 @@ class JaxTrainer:
             placement_group=pg,
         )
         self._last_metrics: Dict[str, Any] = {}
+        # Preemption awareness: subscribe to node_draining notices and
+        # resolve which nodes host this gang — the supervisor half of
+        # drain -> checkpoint -> restore (cluster mode only; the local
+        # runtime has no nodes to lose).
+        watcher: Optional[NodeEventWatcher] = None
+        gang_nodes: Set[str] = set()
+        gcs = getattr(runtime_base.current_runtime(), "_gcs", None)
+        if gcs is not None and sc.num_workers >= 1:
+            try:
+                watcher = NodeEventWatcher(gcs)
+                gang_nodes = self._gang_nodes(gcs, group)
+            except Exception:
+                watcher = None
         try:
             # Backend setup (the analogue of _setup_torch_process_group,
             # reference: train/_internal/backend_executor.py:135 start ->
@@ -212,12 +297,63 @@ class JaxTrainer:
             )
 
             ckpt_index = 0
+            drained: Set[str] = set()
             while True:
-                results = api.get([w.next_result.remote() for w in group.workers])
+                if watcher is not None and not drained:
+                    # drain_noticed, NOT affected: only a real preemption
+                    # notice earns the preemption retry budget — an
+                    # un-noticed node death must keep taking the blunt
+                    # max_failures path.
+                    drained = watcher.drain_noticed(gang_nodes)
+                    if drained:
+                        # Preemption notice for a gang host: ask every
+                        # worker for a final checkpoint + clean return
+                        # (cooperative loops see train.drain_requested();
+                        # others fall back to their last periodic
+                        # checkpoint). Results keep flowing below so the
+                        # final checkpoint is captured before the raise.
+                        _flight_record("train.drain", tuple(sorted(drained)))
+                        for w in group.workers:
+                            try:
+                                w.request_drain.remote()
+                            except Exception:
+                                pass
+                # Bounded rounds (in cluster mode): a worker mid-step in a
+                # long compute answers with the __pending__ sentinel after
+                # 2 s, so the drain check above re-runs even when nothing
+                # is being reported — an unbounded wait here would let the
+                # preemption grace expire before request_drain ever went
+                # out. Local mode keeps the unbounded wait (no watcher, and
+                # the shared-process runtime is latency-sensitive in tests).
+                round_timeout = 2.0 if watcher is not None else None
+                try:
+                    results = api.get(
+                        [w.next_result.remote(round_timeout) for w in group.workers]
+                    )
+                except Exception:
+                    if drained:
+                        # A gang worker died INSIDE the drain grace (the
+                        # node's deadline beat its final checkpoint): this
+                        # is still the preemption, not a training failure —
+                        # surface it as such so fit() restores on the
+                        # preemption retry budget instead of burning
+                        # max_failures on a capacity event.
+                        raise exc.PreemptionError(sorted(drained))
+                    raise
                 if all(r is None for r in results):
                     break
-                live = [r for r in results if r is not None]
-                rank0 = results[0] if results[0] is not None else live[0]
+                live = [
+                    r
+                    for r in results
+                    if r is not None and not r.get("__pending__")
+                ]
+                if not live:
+                    continue  # every worker is mid-step; poll again
+                rank0 = (
+                    results[0]
+                    if results[0] is not None and not results[0].get("__pending__")
+                    else live[0]
+                )
                 self._last_metrics = dict(rank0["metrics"])
                 ckpt_path = rank0.get("checkpoint")
                 if ckpt_path:
@@ -225,9 +361,22 @@ class JaxTrainer:
                     manager.register(persisted, self._last_metrics)
                     ckpt_index += 1
 
-            api.get([w.join.remote() for w in group.workers])
+            try:
+                api.get([w.join.remote() for w in group.workers])
+            except Exception:
+                if drained:
+                    raise exc.PreemptionError(sorted(drained))
+                raise
+            if drained:
+                # The gang stopped because its node(s) are going away, not
+                # because training finished: surface it as a preemption so
+                # fit() restores from the final checkpoint on replacement
+                # capacity.
+                raise exc.PreemptionError(sorted(drained))
             return self._last_metrics
         finally:
+            if watcher is not None:
+                watcher.stop()
             group.shutdown()
             if pg is not None:
                 from ..core.placement_group import remove_placement_group

@@ -28,6 +28,7 @@ class _TrainWorker:
         self._error: Optional[BaseException] = None
         self._mesh = None
         self._session = None
+        self._drain_flag = False
 
     # generic execute (reference: worker_group.py execute)
     def execute(self, fn_blob: bytes, *args, **kwargs):
@@ -105,6 +106,10 @@ class _TrainWorker:
             self._error = e
             raise
         session.mesh = self._mesh
+        if self._drain_flag:
+            # A drain notice landed before the session existed (restart
+            # races): the new session starts pre-drained.
+            session.request_drain()
         self._session = session
 
         def run():
@@ -128,7 +133,12 @@ class _TrainWorker:
         self._thread.start()
         return True
 
-    def next_result(self):
+    def next_result(self, timeout_s=None):
+        """One reported result, None once training finished, or the
+        `{"__pending__": True}` sentinel when `timeout_s` elapsed with
+        nothing reported — the bounded form keeps the trainer's
+        supervision loop responsive (it must notice a drain notice even
+        while every worker is mid-step in a long compute)."""
         import time as _time
 
         # The launch is fire-and-forget and this actor runs methods on a
@@ -145,7 +155,10 @@ class _TrainWorker:
                 return None
             _time.sleep(0.02)
         session = self._session
-        out = session.next_result()
+        try:
+            out = session.next_result(timeout=timeout_s)
+        except TimeoutError:
+            return {"__pending__": True}
         if out is None and self._error is not None:
             raise self._error
         if out is not None and out.get("checkpoint") is not None:
@@ -159,6 +172,15 @@ class _TrainWorker:
         threads blocked on the size-1 queue)."""
         if self._session is not None:
             self._session.cancel()
+        return True
+
+    def request_drain(self):
+        """Relays a preemption notice into the session: the user loop's
+        next `train.drain_requested()` returns True, asking for a final
+        checkpoint + clean return before the node dies."""
+        self._drain_flag = True
+        if self._session is not None:
+            self._session.request_drain()
         return True
 
     def join(self):

@@ -625,6 +625,36 @@ RL_SAMPLE_TIME = Histogram(
     component="rl",
     boundaries=[1, 5, 10, 50, 100, 500, 1000, 5000, 10000, 60000],
 )
+# --- recovery / fault tolerance -------------------------------------------
+# The four counters `ray-tpu status` surfaces as the recovery line: they
+# answer "has this cluster actually been *surviving* failures?" without
+# grepping logs.
+ACTOR_RESTARTS = Counter(
+    "raytpu_actor_restarts_total",
+    "Actor restarts driven by the GCS restart state machine (max_restarts)",
+    component="gcs",
+)
+TASKS_RETRIED = Counter(
+    "raytpu_tasks_retried_total",
+    "Task attempts re-queued after a worker died mid-execution",
+    component="raylet",
+)
+NODES_DRAINED = Counter(
+    "raytpu_nodes_drained_total",
+    "Nodes that entered the draining state on a preemption notice",
+    component="gcs",
+)
+CHECKPOINTS_RESTORED = Counter(
+    "raytpu_checkpoints_restored_total",
+    "Training attempts resumed from a checkpoint after a gang failure",
+    component="train",
+)
+CHAOS_INJECTIONS = Counter(
+    "raytpu_chaos_injections_total",
+    "Faults injected by the chaos controller, by point and action",
+    component="chaos",
+    tag_keys=("point", "action"),
+)
 
 
 # ========================================================== reporter agent

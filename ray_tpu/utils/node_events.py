@@ -1,0 +1,115 @@
+"""Node lifecycle event subscription: the drain/death feed supervisors react to.
+
+The GCS publishes to the general-purpose ``node_events`` pubsub channel:
+
+- ``{"event": "node_draining", "node_id", "deadline_s", "reason"}`` when a
+  preemption notice arrives (``report_preemption`` — synthesized by chaos,
+  the local provider's ``inject_preemption``, or relayed from a cloud API),
+- ``{"event": "node_dead", "node_id"}`` when a node is declared dead
+  (heartbeat expiry or explicit drain_node).
+
+`NodeEventWatcher` is the subscriber side: a daemon thread long-polls the
+channel and maintains the cumulative ``draining`` / ``dead`` node-id sets.
+Gang supervisors (the JaxTrainer driver, the serve controller) poll those
+sets between rounds — cheap, no callback reentrancy, and a missed poll
+only delays a reaction, never loses it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Set
+
+CHANNEL = "node_events"
+
+
+class NodeEventWatcher:
+    def __init__(self, gcs, poll_timeout_s: float = 1.0):
+        self._gcs = gcs
+        self._poll_timeout_s = poll_timeout_s
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.draining: Set[str] = set()
+        self.dead: Set[str] = set()
+        # Grows only: nodes that EVER received a drain notice. `draining`
+        # reflects current state (a dead node leaves it); supervisors
+        # distinguishing "noticed preemption" from "un-noticed crash"
+        # need the cumulative view — the node may drain and die between
+        # two of their polls.
+        self.ever_draining: Set[str] = set()
+        self._events: List[Dict[str, Any]] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="node-events"
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                entries = self._gcs.call(
+                    "pubsub_poll", CHANNEL, self._seq, self._poll_timeout_s,
+                    timeout=self._poll_timeout_s + 10.0,
+                )
+            except Exception:
+                if self._stop.wait(0.5):
+                    return
+                continue
+            with self._lock:
+                for seq, msg in entries:
+                    self._seq = max(self._seq, seq)
+                    if not isinstance(msg, dict):
+                        continue
+                    self._events.append(msg)
+                    del self._events[:-256]
+                    nid = msg.get("node_id")
+                    if not nid:
+                        continue
+                    if msg.get("event") == "node_draining":
+                        self.draining.add(nid)
+                        self.ever_draining.add(nid)
+                    elif msg.get("event") == "node_dead":
+                        self.dead.add(nid)
+                        # A dead node is no longer "draining" — it's gone.
+                        self.draining.discard(nid)
+
+    def affected(self, node_ids) -> Set[str]:
+        """The subset of `node_ids` that is draining or dead."""
+        with self._lock:
+            lost = self.draining | self.dead
+        return {n for n in node_ids if n in lost}
+
+    def drain_noticed(self, node_ids) -> Set[str]:
+        """The subset of `node_ids` that ever received a preemption
+        notice. Distinct from affected(): an un-noticed crash (node_dead
+        with no prior node_draining) is a FAILURE, not a preemption, and
+        must not be granted the gentler preemption retry budget."""
+        with self._lock:
+            return {n for n in node_ids if n in self.ever_draining}
+
+    def draining_nodes(self) -> Set[str]:
+        """Locked snapshot of the draining set (the poll thread mutates
+        it concurrently — callers must not iterate the live set)."""
+        with self._lock:
+            return set(self.draining)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def actor_locations(gcs) -> Dict[str, str]:
+    """actor_id(hex) -> node_id for every actor the GCS knows — the
+    resolution gang supervisors (trainer, serve controller) use to map a
+    drain notice to their own members. Empty on any GCS error: a
+    supervisor that cannot resolve locations simply reacts a tick later."""
+    try:
+        return {
+            a["actor_id"]: a.get("node_id")
+            for a in gcs.call("list_actors", 100_000)
+        }
+    except Exception:
+        return {}

@@ -59,6 +59,12 @@ REQUIRED = {
     "ray_tpu.observability.flight_recorder",
     "ray_tpu.observability.perfetto",
     "ray_tpu.tracing",
+    # The chaos controller imports into every worker/raylet (its
+    # injection points live on the task/channel/collective hot paths);
+    # a backend init here would wedge the cluster with chaos DISARMED.
+    "ray_tpu.chaos",
+    "ray_tpu.chaos.controller",
+    "ray_tpu.utils.node_events",
 }
 
 
