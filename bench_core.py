@@ -277,6 +277,56 @@ def bench_tracing_overhead_guard(min_time: float) -> None:
     )
 
 
+def bench_history_watchdog_overhead_guard(min_time: float) -> None:
+    """Metrics-history + SLO-watchdog overhead guard.
+
+    Both live in the GCS (history samples land on the ~1 Hz metric-merge
+    path; the watchdog evaluates rules once per second off the task fast
+    path), so the shipped default — retention on, default rules armed —
+    must cost <2% of end-to-end tasks/s vs both disabled. Daemons read
+    RAY_TPU_METRICS_HISTORY / RAY_TPU_WATCHDOG from their spawn
+    environment, so each measurement is its own cluster boot —
+    INTERLEAVED off/on/off/on with best-of per config, because
+    boot-to-boot drift on a small box otherwise dwarfs a 2% budget."""
+    import os
+
+    keys = ("RAY_TPU_METRICS_HISTORY", "RAY_TPU_WATCHDOG")
+    saved = {k: os.environ.get(k) for k in keys}
+    rates = {"off": 0.0, "on": 0.0}
+    try:
+        for _trial in range(3):
+            for label, flag in (("off", "0"), ("on", "1")):
+                for k in keys:
+                    os.environ[k] = flag
+                rt.init(num_cpus=8, num_workers=2, object_store_memory=256 << 20)
+                rates[label] = max(rates[label], _sync_dispatch_rate(min_time))
+                rt.shutdown()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    ratio = rates["on"] / rates["off"] if rates["off"] else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "history_watchdog_overhead",
+                "value": round(ratio, 3),
+                "unit": "x (history+watchdog armed/disabled sync dispatch)",
+                "vs_baseline": None,
+                "on_ops_s": round(rates["on"], 1),
+                "off_ops_s": round(rates["off"], 1),
+            }
+        ),
+        flush=True,
+    )
+    assert ratio >= 0.98, (
+        f"metrics history + armed watchdogs cost {100 * (1 - ratio):.1f}% "
+        f"of no-op dispatch (budget: 2%) — {rates}"
+    )
+
+
 def bench_chaos_overhead_guard(min_time: float) -> None:
     """Chaos injection-point overhead guard.
 
@@ -617,6 +667,7 @@ def main():
     bench_overhead_guard(min_time)
     bench_tracing_overhead_guard(min_time)
     bench_chaos_overhead_guard(min_time)
+    bench_history_watchdog_overhead_guard(min_time)
 
 
 if __name__ == "__main__":

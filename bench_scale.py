@@ -110,6 +110,100 @@ def many_pgs(n: int) -> None:
     emit("many_pgs_create_remove_per_s", n / dt, "pgs/s", n=n)
 
 
+def actor_launch_breakdown(spans) -> dict:
+    """Stage-latency stats from the PR-1 actor-launch tracing spans
+    (gcs_register -> submit -> worker_spawn -> init, plus the outer
+    actor_launch total): stage -> {count, p50_ms, p90_ms, max_ms,
+    mean_ms}. The profiling groundwork ROADMAP open item 3 asks for —
+    which stage eats the 26x gap is the first question."""
+    stages: dict = {}
+    for sp in spans:
+        name = sp.get("name") or ""
+        if not name.startswith("actor_launch"):
+            continue
+        start, end = sp.get("start_us"), sp.get("end_us")
+        if start is None or end is None:
+            continue
+        stage = name.split(".", 1)[1] if "." in name else "total"
+        stages.setdefault(stage, []).append((end - start) / 1e3)
+    out = {}
+    for stage, vals in stages.items():
+        vals.sort()
+        n = len(vals)
+        out[stage] = {
+            "count": n,
+            "p50_ms": round(vals[n // 2], 3),
+            "p90_ms": round(vals[min(n - 1, int(n * 0.9))], 3),
+            "max_ms": round(vals[-1], 3),
+            "mean_ms": round(sum(vals) / n, 3),
+        }
+    return out
+
+
+def actor_launch_profile(n: int) -> None:
+    """Separate traced phase (own cluster boot): tracing perturbs the
+    sustained-throughput numbers above, so the launch-path breakdown
+    runs against a fresh cluster with RAY_TPU_TRACING=1 in the daemons'
+    spawn environment and reports per-stage latency histograms."""
+    import os
+    import shutil
+    import tempfile
+
+    from ray_tpu import tracing
+
+    trace_dir = tempfile.mkdtemp(prefix="bench_launch_traces_")
+    saved = {
+        k: os.environ.get(k) for k in ("RAY_TPU_TRACING", "RAY_TPU_TRACE_DIR")
+    }
+    os.environ["RAY_TPU_TRACING"] = "1"
+    os.environ["RAY_TPU_TRACE_DIR"] = trace_dir
+    try:
+        rt.init(num_cpus=16, num_workers=2, object_store_memory=256 << 20)
+
+        @rt.remote
+        class A:
+            def ping(self):
+                return 1
+
+        actors = [A.remote() for _ in range(n)]
+        rt.get([a.ping.remote() for a in actors], timeout=600)
+        for a in actors:
+            rt.kill(a)
+        # Daemons must be down BEFORE span collection + the finally's
+        # rmtree: they keep writing span files until shutdown (the
+        # finally's own shutdown is the failure-path cleanup).
+        rt.shutdown()
+        breakdown = actor_launch_breakdown(tracing.collect(trace_dir))
+        order = ("total", "gcs_register", "submit", "worker_spawn", "init")
+        print("actor-launch stage breakdown (ms):", flush=True)
+        print(f"  {'STAGE':<14} {'COUNT':>5} {'P50':>9} {'P90':>9} {'MAX':>9}")
+        for stage in sorted(breakdown, key=lambda s: order.index(s) if s in order else 99):
+            st = breakdown[stage]
+            print(
+                f"  {stage:<14} {st['count']:>5} {st['p50_ms']:>9.2f} "
+                f"{st['p90_ms']:>9.2f} {st['max_ms']:>9.2f}"
+            )
+            emit(
+                f"actor_launch_{stage}_p50_ms",
+                st["p50_ms"],
+                "ms",
+                p90_ms=st["p90_ms"],
+                max_ms=st["max_ms"],
+                count=st["count"],
+            )
+    finally:
+        try:
+            rt.shutdown()  # idempotent; reaps the cluster on failure paths
+        except Exception:
+            pass
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(trace_dir, ignore_errors=True)
+
+
 def large_object(gb: float) -> None:
     """Single large object put+get round trip (the scalability envelope
     quotes 100 GiB+ single objects on the big cluster; bounded here by
@@ -140,6 +234,9 @@ def main():
         large_object(gb=0.5 if quick else 1.0)
     finally:
         rt.shutdown()
+    # Traced launch-path breakdown runs AFTER the clean-throughput phase
+    # (its own cluster, tracing armed at daemon spawn).
+    actor_launch_profile(n=10 if quick else 40)
 
 
 if __name__ == "__main__":

@@ -69,6 +69,17 @@ class GcsService:
         # runtime metrics aggregated by the head's metrics agent) — same
         # merge semantics as the user table, separate namespace.
         self._internal_metrics: Dict[Tuple, dict] = {}
+        # Per-series time-series retention: every internal-metrics merge
+        # also lands a (bounded, rolled-up) history sample, so rates and
+        # regressions stay answerable after the moment passes
+        # (observability/history.py; queried via `metrics_history`).
+        from ..observability import history as _history_mod
+
+        self._history = (
+            _history_mod.MetricsHistory()
+            if _history_mod.history_enabled()
+            else None
+        )
         # General pubsub channels: name -> [(seq, message)] (bounded).
         self._pubsub: Dict[str, List[Tuple[int, Any]]] = {}
         self._pubsub_total = 0  # running entry count across channels
@@ -89,6 +100,19 @@ class GcsService:
             self._wal_f = open(self._wal_path, "ab")
         self._health = threading.Thread(target=self._health_loop, daemon=True)
         self._health.start()
+        # SLO watchdog: rules over the history stream, alerts onto the
+        # node_events channel (observability/watchdog.py). Needs history.
+        self._watchdog = None
+        if self._history is not None:
+            from ..observability import watchdog as _watchdog_mod
+
+            if _watchdog_mod.watchdog_enabled():
+                self._watchdog = _watchdog_mod.Watchdog(
+                    history=self._history,
+                    publish=lambda msg: self.pubsub_publish("node_events", msg),
+                    metrics_fn=self.internal_metrics,
+                )
+                self._watchdog.start()
 
     # ------------------------------------------------------- persistence
     # Durable control-plane state (reference: gcs/store_client/
@@ -400,12 +424,17 @@ class GcsService:
         return out
 
     def _merge_metric_records(
-        self, table: Dict[Tuple, dict], worker_id: str, records: List[dict]
+        self,
+        table: Dict[Tuple, dict],
+        worker_id: str,
+        records: List[dict],
+        history=None,
     ) -> bool:
         """Shared aggregation for the user and internal metrics tables
         (reference: src/ray/stats/metric.h registry + exporter). Counters
         accumulate deltas; gauges keep the last value per (worker, tags);
-        histograms merge bucket counts."""
+        histograms merge bucket counts. With `history`, every merged
+        series also lands a cumulative sample in the history rings."""
         with self._lock:
             for rec in records:
                 key = (rec["name"], tuple(sorted(rec.get("tags", {}).items())))
@@ -430,6 +459,32 @@ class GcsService:
                     if len(have) == len(counts):
                         entry["counts"] = [a + b for a, b in zip(have, counts)]
                     entry.setdefault("boundaries", rec.get("boundaries"))
+                if history is not None:
+                    if rec["kind"] == "counter":
+                        history.observe(
+                            entry["name"], "counter", entry["tags"], entry["value"]
+                        )
+                    elif rec["kind"] == "gauge":
+                        # Cluster aggregate with the SAME 30 s staleness
+                        # rule as _metrics_view: a dead worker's last
+                        # value (same tags, different worker_id) must
+                        # not inflate history samples until something
+                        # happens to render the table view.
+                        now_m = time.monotonic()
+                        total = sum(
+                            v
+                            for v, ts in entry["gauges"].values()
+                            if now_m - ts < 30.0
+                        )
+                        history.observe(entry["name"], "gauge", entry["tags"], total)
+                    elif rec["kind"] == "histogram":
+                        history.observe(
+                            entry["name"],
+                            "histogram",
+                            entry["tags"],
+                            float(sum(entry.get("counts") or [])),
+                            hist_sum=entry["value"],
+                        )
         return True
 
     def _metrics_view(self, table: Dict[Tuple, dict]) -> List[dict]:
@@ -465,10 +520,35 @@ class GcsService:
     def report_internal_metrics(self, worker_id: str, records: List[dict]) -> bool:
         """Runtime-internal metrics (ray_tpu.utils.internal_metrics) —
         flushed by raylets, the GCS itself, workers, and drivers."""
-        return self._merge_metric_records(self._internal_metrics, worker_id, records)
+        return self._merge_metric_records(
+            self._internal_metrics, worker_id, records, history=self._history
+        )
 
     def internal_metrics(self) -> List[dict]:
         return self._metrics_view(self._internal_metrics)
+
+    def metrics_history(
+        self,
+        name: Optional[str] = None,
+        tags: Optional[dict] = None,
+        window_s: Optional[float] = None,
+        as_rate: bool = False,
+    ) -> List[dict]:
+        """Time-series view of the internal-metrics table: matching
+        series with [ts, value] ([ts, count, sum] for histograms)
+        samples — fine-resolution recent, rolled-up old. Empty when
+        retention is disabled (RAY_TPU_METRICS_HISTORY=0)."""
+        if self._history is None:
+            return []
+        return self._history.query(
+            name=name, tags=tags, window_s=window_s, as_rate=as_rate
+        )
+
+    def active_alerts(self) -> List[dict]:
+        """Currently-firing SLO watchdog alerts (empty when disarmed)."""
+        if self._watchdog is None:
+            return []
+        return self._watchdog.active_alerts()
 
     def _observe_rpc(self, method: str, latency_ms: float) -> None:
         """Per-method RPC accounting hook invoked by RpcServer (only the
@@ -591,11 +671,39 @@ class GcsService:
                 for pg_id in stranded:
                     self._reschedule_gang(pg_id)
             dead = []
+            lag_records: List[dict] = []
             with self._lock:
                 for nid, n in self._nodes.items():
                     if n["alive"] and time.monotonic() - n["last_hb"] > HEARTBEAT_TIMEOUT_S:
                         n["alive"] = False
                         dead.append(nid)
+                    elif n["alive"] and tick % 10 == 0 and self._history is not None:
+                        # Heartbeat lag gauge, once per second per alive
+                        # node: the signal the heartbeat_lag watchdog
+                        # rule (and `ray-tpu top`) watches. Fed through
+                        # the normal report path so the table, /metrics,
+                        # and history all agree.
+                        # Record shape tied to the declared instrument
+                        # (name/component/tag come from the catalog so a
+                        # rename cannot desynchronize them); hand-built
+                        # rather than set on the Gauge because this must
+                        # land SYNCHRONOUSLY — an in-process GcsService
+                        # has no flusher wired to itself.
+                        lag = imet.NODE_HEARTBEAT_LAG
+                        lag_records.append(
+                            {
+                                "name": lag.name,
+                                "kind": lag.kind,
+                                "value": time.monotonic() - n["last_hb"],
+                                "tags": {
+                                    "component": lag.component,
+                                    "node_id": "gcs",
+                                    lag.tag_keys[0]: nid[:12],
+                                },
+                            }
+                        )
+            if lag_records:
+                self.report_internal_metrics("gcs", lag_records)
             for nid in dead:
                 self._on_node_death(nid)
 
@@ -1438,6 +1546,8 @@ class GcsService:
 
     def stop(self) -> bool:
         self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.stop()
         return True
 
 

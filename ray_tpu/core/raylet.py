@@ -1503,6 +1503,17 @@ class RayletService:
                 pass
         return {"path": path, "workers_signaled": signaled, "dir": _fr.flight_dir()}
 
+    def profile(self, seconds: float = 5.0) -> dict:
+        """`ray-tpu debug profile`: runs the in-process sampling profiler
+        for `seconds` and dumps hottest stacks (JSON for the trace merge
+        + text for humans). Blocking by design — the RPC returns when the
+        dump is on disk; the server thread pool absorbs the wait."""
+        from ..utils import sampling_profiler
+
+        return sampling_profiler.run_for(
+            seconds, name=f"raylet-{self.node_id[:12]}"
+        )
+
     # ----------------------------------------------------- worker service
     def worker_poll(self, worker_id: str) -> dict:
         """Long-poll: the worker's task mailbox (reference: the PushTask

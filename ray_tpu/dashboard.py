@@ -216,6 +216,8 @@ class _Dashboard:
                 return gcs.call("user_metrics")
             if path == "internal_metrics":
                 return gcs.call("internal_metrics")
+            if path == "alerts":
+                return gcs.call("active_alerts")
             if path == "jobs":
                 from .jobs import list_job_records
 
@@ -268,6 +270,29 @@ class _Dashboard:
                         self._reply(
                             200, text.encode(), "text/plain; version=0.0.4"
                         )
+                    except Exception as e:  # noqa: BLE001
+                        self._reply(500, json.dumps({"error": repr(e)}).encode())
+                    return
+                if self.path.startswith("/api/metrics_history"):
+                    # Time-series query route: ?name=...&window_s=...&
+                    # rate=1&tag.<key>=<value> (tag.* are subset filters).
+                    from urllib.parse import parse_qs, urlparse
+
+                    try:
+                        q = parse_qs(urlparse(self.path).query)
+                        name = (q.get("name") or [None])[0]
+                        raw_window = (q.get("window_s") or [None])[0]
+                        window_s = float(raw_window) if raw_window else None
+                        as_rate = (q.get("rate") or ["0"])[0] in ("1", "true")
+                        tags = {
+                            k[len("tag."):]: v[0]
+                            for k, v in q.items()
+                            if k.startswith("tag.")
+                        }
+                        series = gcs.call(
+                            "metrics_history", name, tags or None, window_s, as_rate
+                        )
+                        self._reply(200, json.dumps(series, default=str).encode())
                     except Exception as e:  # noqa: BLE001
                         self._reply(500, json.dumps({"error": repr(e)}).encode())
                     return

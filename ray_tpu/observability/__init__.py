@@ -20,7 +20,10 @@ from .flight_recorder import (  # noqa: F401
     install_crash_hooks,
     record,
 )
+from .goodput import GoodputAccountant  # noqa: F401
+from .history import MetricsHistory, merge_series  # noqa: F401
 from .perfetto import build_trace, export  # noqa: F401
+from .watchdog import Rule, Watchdog  # noqa: F401
 
 __all__ = [
     "tracing",
@@ -32,4 +35,9 @@ __all__ = [
     "install_crash_hooks",
     "build_trace",
     "export",
+    "MetricsHistory",
+    "merge_series",
+    "Watchdog",
+    "Rule",
+    "GoodputAccountant",
 ]

@@ -192,6 +192,71 @@ def flight_events(dumps: List[dict]) -> List[dict]:
     return events
 
 
+def collect_profiles(directory: Optional[str] = None) -> List[dict]:
+    """Sampling-profiler dumps (utils/sampling_profiler.py JSON twins) —
+    every process's, tolerating partial/corrupt files like the other
+    collectors."""
+    import os
+
+    if directory is None:
+        from ..utils.sampling_profiler import profile_dir
+
+        directory = profile_dir()
+    out: List[dict] = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for fname in names:
+        if not (fname.startswith("profile_") and fname.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(directory, fname), errors="replace") as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(payload, dict) and isinstance(payload.get("stacks"), list):
+            out.append(payload)
+    return out
+
+
+def profile_events(profiles: List[dict], top_n: int = 25) -> List[dict]:
+    """Hottest-stack instants from sampling-profiler dumps: one `i`
+    event per top stack on the process's "profiler" track at dump time,
+    with the sample count and share in args — the aggregated profile is
+    not a timeline, but landing it on the same view answers "what was
+    this daemon DOING" next to the spans that were slow."""
+    events: List[dict] = []
+    for prof in profiles:
+        pid = prof.get("pid", 0)
+        dump_us = prof.get("dump_us", 0)
+        total = max(1, int(prof.get("samples") or 1))
+        for entry in (prof.get("stacks") or [])[:top_n]:
+            try:
+                count, stack = int(entry[0]), str(entry[1])
+            except (TypeError, ValueError, IndexError):
+                continue
+            top_frame = stack.split(" < ", 1)[0]
+            events.append(
+                {
+                    "name": f"{top_frame} ({count})",
+                    "cat": "profile",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": dump_us,
+                    "pid": pid,
+                    "tid": "profiler",
+                    "args": {
+                        "stack": stack,
+                        "count": count,
+                        "share": round(count / total, 4),
+                        "profile": prof.get("name", ""),
+                    },
+                }
+            )
+    return events
+
+
 def counter_events(metrics: List[dict], ts_us: int) -> List[dict]:
     """Counter tracks sampled at export time (the internal-metrics table
     holds current aggregates, not history — one sample per series)."""
@@ -244,6 +309,7 @@ def build_trace(
     dumps: Optional[List[dict]] = None,
     task_events: Optional[List[dict]] = None,
     metrics: Optional[List[dict]] = None,
+    profiles: Optional[List[dict]] = None,
 ) -> dict:
     """Assembles the full chrome-trace object. Events are stable-sorted
     by timestamp (metadata first — required by some importers)."""
@@ -254,6 +320,7 @@ def build_trace(
     events += span_events(spans or [], dump_us=now_us)
     events += flow_events(spans or [])
     events += flight_events(dumps or [])
+    events += profile_events(profiles or [])
     events += list(task_events or [])
     if metrics:
         events += counter_events(metrics, now_us)
@@ -275,8 +342,13 @@ def export(
 
     spans = tracing.collect(trace_directory)
     dumps = flight_recorder.collect()
+    profiles = collect_profiles()
     trace = build_trace(
-        spans=spans, dumps=dumps, task_events=task_events, metrics=metrics
+        spans=spans,
+        dumps=dumps,
+        task_events=task_events,
+        metrics=metrics,
+        profiles=profiles,
     )
     if path:
         with open(path, "w") as f:
@@ -287,6 +359,7 @@ def export(
         "spans": len(spans),
         "flows": n_flows,
         "flight_dumps": len(dumps),
+        "profiles": len(profiles),
         "task_events": len(task_events or []),
     }
     return {"trace": trace, "summary": summary}

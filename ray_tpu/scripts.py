@@ -203,7 +203,11 @@ def cmd_status(args) -> None:
     }
     totals = {label: 0.0 for label in recovery.values()}
     try:
-        for m in state.internal_metrics():
+        metrics_records = state.internal_metrics()
+    except Exception:
+        metrics_records = []
+    try:
+        for m in metrics_records:
             label = recovery.get(m.get("name"))
             if label:
                 totals[label] += float(m.get("value") or 0.0)
@@ -214,6 +218,50 @@ def cmd_status(args) -> None:
             "recovery: "
             + " ".join(f"{k}={int(v)}" for k, v in totals.items())
         )
+    # Efficiency gauges: is the hardware earning its keep? (goodput =
+    # productive fraction of training wall time; MFU + tokens/s mirrored
+    # from train.report.) Entries whose reporters were all pruned keep a
+    # 0.0 table value forever — skip them, don't report a dead run as
+    # "goodput=0.000". Reuses the metrics fetched for the recovery line.
+    eff = {}
+    try:
+        for m in metrics_records:
+            if m.get("kind") == "gauge" and not m.get("gauges"):
+                continue
+            name, val = m.get("name"), float(m.get("value") or 0.0)
+            if name == "raytpu_train_goodput":
+                eff["goodput"] = min(eff.get("goodput", 1.0), val)
+            elif name == "raytpu_train_mfu":
+                eff.setdefault("mfu", []).append(val)
+            elif name == "raytpu_train_tokens_per_s":
+                eff["tokens_per_s"] = eff.get("tokens_per_s", 0.0) + val
+    except Exception:
+        eff = {}
+    if eff:
+        parts = []
+        if "goodput" in eff:
+            parts.append(f"goodput={eff['goodput']:.3f}")
+        if eff.get("mfu"):
+            parts.append(f"mfu={sum(eff['mfu']) / len(eff['mfu']):.3f}")
+        if "tokens_per_s" in eff:
+            parts.append(f"tokens/s={eff['tokens_per_s']:g}")
+        if parts:
+            print("efficiency: " + " ".join(parts))
+    # Active SLO alerts (observability/watchdog.py): the reactive layer's
+    # current verdict on the cluster.
+    try:
+        alerts = state.active_alerts()
+    except Exception:
+        alerts = []
+    if alerts:
+        for a in alerts:
+            print(
+                f"ALERT {a['rule']}: {a['metric']} {a.get('stat', 'value')}="
+                f"{a['value']:g} {a['op']} {a['threshold']:g}"
+                + (f" — {a['description']}" if a.get("description") else "")
+            )
+    else:
+        print("alerts: none")
 
 
 _CLUSTER_STATE_DIR = os.path.expanduser("~/.ray_tpu/clusters")
@@ -524,14 +572,186 @@ def format_metrics_table(sections) -> str:
     )
 
 
+def _filter_records(records, pattern):
+    if not pattern:
+        return records
+    return [r for r in records if pattern in (r.get("name") or "")]
+
+
+def _metric_key(m) -> tuple:
+    return (m.get("name"), tuple(sorted((m.get("tags") or {}).items())))
+
+
+def _cumulative_value(m) -> float:
+    if m.get("kind") == "histogram":
+        return float(sum(m.get("counts") or []))
+    return float(m.get("value") or 0.0)
+
+
+def format_watch_table(cur, prev, dt: float) -> str:
+    """One tick of `ray-tpu metrics --watch`: per series, the current
+    value plus the per-second rate since the previous snapshot
+    (counters/histograms; gauges show their value — rate of a level is
+    noise). `prev` maps _metric_key -> cumulative value; "-" marks
+    series with no previous snapshot yet."""
+    rows = [("NAME", "KIND", "TAGS", "VALUE", "RATE/S")]
+    for m in sorted(cur, key=lambda r: (r.get("name", ""), str(r.get("tags")))):
+        tags = m.get("tags") or {}
+        tag_str = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+        kind = m.get("kind", "?")
+        value = _cumulative_value(m)
+        if kind == "gauge":
+            rate = ""
+        else:
+            before = prev.get(_metric_key(m))
+            rate = (
+                f"{(value - before) / dt:+.6g}"
+                if before is not None and dt > 0
+                else "-"
+            )
+        rows.append((m.get("name", "?"), kind, tag_str, f"{value:g}", rate))
+    widths = [max(len(r[i]) for r in rows) for i in range(4)]
+    return "\n".join(
+        "  ".join(col.ljust(w) for col, w in zip(r[:4], widths)) + "  " + r[4]
+        for r in rows
+    )
+
+
 def cmd_metrics(args) -> None:
     _connect(args)
     from .utils import state
 
-    internal = state.internal_metrics()
-    user = state.user_metrics()
-    print(format_metrics_table([("internal", internal), ("user", user)]))
-    print(f"\n{len(internal)} internal + {len(user)} user metric series")
+    pattern = getattr(args, "filter", None)
+    if not getattr(args, "watch", False):
+        internal = _filter_records(state.internal_metrics(), pattern)
+        user = _filter_records(state.user_metrics(), pattern)
+        print(format_metrics_table([("internal", internal), ("user", user)]))
+        print(f"\n{len(internal)} internal + {len(user)} user metric series")
+        return
+    # --watch: tail rates instead of printing one snapshot. Counters and
+    # histogram counts show deltas/s against the previous tick.
+    prev: dict = {}
+    prev_ts = None
+    n = 0
+    while True:
+        records = _filter_records(
+            state.internal_metrics() + state.user_metrics(), pattern
+        )
+        now = time.monotonic()
+        dt = (now - prev_ts) if prev_ts is not None else 0.0
+        if n and sys.stdout.isatty():
+            print("\x1b[2J\x1b[H", end="")
+        print(format_watch_table(records, prev, dt))
+        print(f"\n[{time.strftime('%H:%M:%S')}] {len(records)} series; ctrl-c to stop")
+        prev = {_metric_key(m): _cumulative_value(m) for m in records}
+        prev_ts = now
+        n += 1
+        if args.iterations and n >= args.iterations:
+            return
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return
+
+
+# ----------------------------------------------------------------- `top`
+# (label, metric, mode, scale, unit, cross-series agg)
+TOP_SIGNALS = [
+    ("tasks/s", "raytpu_sched_dispatch_latency_ms", "rate", 1.0, "/s", "sum"),
+    ("gcs rpc/s", "raytpu_gcs_rpc_total", "rate", 1.0, "/s", "sum"),
+    ("pubsub backlog", "raytpu_gcs_pubsub_backlog", "value", 1.0, "", "sum"),
+    ("cgraph MB/s", "raytpu_cgraph_channel_bytes_total", "rate", 1e-6, "MB/s", "sum"),
+    ("device HBM MiB", "raytpu_device_mem_used_bytes", "value", 1.0 / (1 << 20), "MiB", "sum"),
+    ("node cpu %", "raytpu_node_cpu_percent", "value", 1.0, "%", "mean"),
+    ("heartbeat lag s", "raytpu_node_heartbeat_lag_s", "value", 1.0, "s", "max"),
+    ("actor restarts", "raytpu_actor_restarts_total", "value", 1.0, "", "sum"),
+    ("nodes drained", "raytpu_nodes_drained_total", "value", 1.0, "", "sum"),
+    ("train goodput", "raytpu_train_goodput", "value", 1.0, "", "mean"),
+    ("serve req/s", "raytpu_serve_requests_total", "rate", 1.0, "/s", "sum"),
+]
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 32) -> str:
+    """Unicode block sparkline of the last `width` values, scaled to the
+    window's own min..max (a flat line is a flat line, not noise)."""
+    vals = list(values)[-width:]
+    if not vals:
+        return ""
+    if max(vals) == min(vals):
+        # Constant signal: a flat mid line, not a wall of full blocks.
+        return ("▄" if vals[0] else _SPARK_BLOCKS[0]) * len(vals)
+    lo = min(min(vals), 0.0)  # rates anchor at zero, not the window min
+    hi = max(vals)
+    span = hi - lo
+    return "".join(
+        _SPARK_BLOCKS[min(7, int((v - lo) / span * 8))] for v in vals
+    )
+
+
+def render_top(fetch, alerts, window_s: float = 120.0, width: int = 32) -> str:
+    """The `ray-tpu top` frame: per key signal, current value +
+    sparkline over the history window. `fetch(metric, as_rate)` returns
+    history series (injected for tests); `alerts` is the active-alert
+    list rendered on top."""
+    from .observability.history import merge_series
+
+    lines = []
+    if alerts:
+        for a in alerts:
+            lines.append(
+                f"ALERT {a['rule']}: {a['metric']}={a['value']:g} "
+                f"{a['op']} {a['threshold']:g}"
+            )
+    else:
+        lines.append("alerts: none")
+    bucket_s = max(1.0, window_s / width)
+    for label, metric, mode, scale, unit, agg in TOP_SIGNALS:
+        try:
+            series = fetch(metric, mode == "rate")
+        except Exception:
+            series = []
+        merged = merge_series(series, bucket_s=bucket_s, agg=agg)
+        if not merged:
+            lines.append(f"{label:<18} {'-':>12}       (no data)")
+            continue
+        values = [v * scale for _, v in merged]
+        lines.append(
+            f"{label:<18} {values[-1]:>12.6g}{unit:<5} {sparkline(values, width)}"
+        )
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> None:
+    """`ray-tpu top`: live rates + sparklines for the key cluster
+    signals, straight off the GCS metrics-history rings."""
+    _connect(args)
+    from .utils import state
+
+    n = 0
+    while True:
+        def fetch(metric, as_rate):
+            return state.metrics_history(
+                metric, None, args.window, as_rate
+            )
+
+        try:
+            alerts = state.active_alerts()
+        except Exception:
+            alerts = []
+        frame = render_top(fetch, alerts, window_s=args.window)
+        if n and sys.stdout.isatty():
+            print("\x1b[2J\x1b[H", end="")
+        print(frame)
+        print(f"\n[{time.strftime('%H:%M:%S')}] window={args.window:g}s; ctrl-c to stop")
+        n += 1
+        if args.iterations and n >= args.iterations:
+            return
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return
 
 
 def cmd_timeline(args) -> None:
@@ -573,8 +793,8 @@ def cmd_trace(args) -> None:
     print(
         f"wrote {s['events']} events to {args.out} "
         f"({s['spans']} spans, {s['flows']} flow arrows, "
-        f"{s['flight_dumps']} flight dumps, {s['task_events']} task rows) "
-        "— open at ui.perfetto.dev"
+        f"{s['flight_dumps']} flight dumps, {s.get('profiles', 0)} profiles, "
+        f"{s['task_events']} task rows) — open at ui.perfetto.dev"
     )
     if not s["spans"]:
         print(
@@ -586,9 +806,53 @@ def cmd_trace(args) -> None:
 def cmd_debug(args) -> None:
     """`ray-tpu debug dump`: flight-recorder post-mortem on demand — every
     raylet dumps its ring and fans SIGUSR2 out to its workers (their
-    handlers dump too); the driver CLI dumps its own."""
+    handlers dump too); the driver CLI dumps its own.
+    `ray-tpu debug profile --seconds N`: every raylet runs its in-process
+    sampling profiler for N seconds and dumps hottest-stacks JSON+text
+    under the profile dir (merged by `ray-tpu trace`)."""
+    if args.action == "profile":
+        _connect(args)
+        from .core.rpc import RpcClient
+        from .utils import state
+        from .utils.sampling_profiler import profile_dir
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        alive = [n for n in state.list_nodes() if n.get("Alive")]
+
+        def one(n):
+            return RpcClient(n["sock"], connect_timeout=5.0).call(
+                "profile", args.seconds, timeout=args.seconds + 30.0
+            )
+
+        paths = []
+        # Concurrent fan-out: every node samples the SAME window (a
+        # sequential walk would offset each node's profile by the full
+        # duration, defeating cross-node comparison) and the command
+        # returns in ~seconds, not nodes x seconds.
+        with ThreadPoolExecutor(max_workers=max(1, len(alive))) as pool:
+            for n, fut in [(n, pool.submit(one, n)) for n in alive]:
+                try:
+                    res = fut.result()
+                except Exception as e:  # noqa: BLE001
+                    print(
+                        f"warning: node {n['NodeID'][:12]} profile failed: {e}",
+                        file=sys.stderr,
+                    )
+                    continue
+                if res.get("path"):
+                    paths.append(res["path"])
+                    print(
+                        f"node {n['NodeID'][:12]}: {res['samples']} samples "
+                        f"-> {res['path']}"
+                    )
+        print(f"wrote {len(paths)} profiles under {profile_dir()}")
+        print("merge into a timeline with: ray-tpu trace --out trace.json")
+        return
     if args.action != "dump":
-        raise SystemExit(f"unknown debug action {args.action!r} (expected: dump)")
+        raise SystemExit(
+            f"unknown debug action {args.action!r} (expected: dump | profile)"
+        )
     _connect(args)
     from .core.rpc import RpcClient
     from .observability import flight_recorder
@@ -709,7 +973,37 @@ def main(argv=None) -> None:
         "metrics", help="dump current internal + user metrics as a table"
     )
     p.add_argument("--address", default=None)
+    p.add_argument(
+        "--filter", default=None, help="only metrics whose name contains this"
+    )
+    p.add_argument(
+        "--watch",
+        action="store_true",
+        help="tail metric rates (deltas/s per tick) instead of one snapshot",
+    )
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument(
+        "--iterations",
+        type=int,
+        default=0,
+        help="stop --watch after N ticks (0 = until ctrl-c)",
+    )
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "top",
+        help="live cluster signals: rates + sparklines from metrics history",
+    )
+    p.add_argument("--address", default=None)
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--window", type=float, default=120.0)
+    p.add_argument(
+        "--iterations",
+        type=int,
+        default=0,
+        help="stop after N frames (0 = until ctrl-c)",
+    )
+    p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser("dashboard", help="serve the cluster dashboard")
     p.add_argument("--address", default=None)
@@ -731,10 +1025,18 @@ def main(argv=None) -> None:
     p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser(
-        "debug", help="debug utilities: `debug dump` writes flight-recorder rings"
+        "debug",
+        help="debug utilities: `debug dump` writes flight-recorder rings; "
+        "`debug profile --seconds N` samples every raylet's stacks",
     )
-    p.add_argument("action", help="dump")
+    p.add_argument("action", help="dump | profile")
     p.add_argument("--address", default=None)
+    p.add_argument(
+        "--seconds",
+        type=float,
+        default=5.0,
+        help="profile duration per node (profile action)",
+    )
     p.set_defaults(fn=cmd_debug)
 
     args = ap.parse_args(argv)

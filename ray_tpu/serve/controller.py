@@ -51,6 +51,11 @@ class Replica:
 
         self._m_requests = imet.SERVE_REQUESTS.labels(deployment=app_name)
         self._m_latency = imet.SERVE_REQUEST_LATENCY.labels(deployment=app_name)
+        # TTFT (first result/chunk) + live queue depth: the serving
+        # efficiency signals the history layer and the serve_ttft_p99
+        # watchdog rule consume.
+        self._m_ttft = imet.SERVE_TTFT.labels(deployment=app_name)
+        self._m_qdepth = imet.SERVE_QUEUE_DEPTH.labels(deployment=app_name)
         # Streaming responses: generator outputs run in a background thread
         # into a bounded queue, pulled chunk-wise by the caller (reference:
         # replica.py handle_request_streaming over the streaming generator
@@ -69,9 +74,13 @@ class Replica:
         with self._lock:
             self._ongoing += 1
             self._total += 1
+            # Gauge set under the lock: a lost-update race between two
+            # finishing requests would otherwise pin a stale depth.
+            self._m_qdepth.set(self._ongoing)
         self._m_requests.inc()
         req_t0 = _time.perf_counter()
         streaming = False
+        succeeded = False
         try:
             # Per-request context (multiplexed model id etc.) for
             # serve.get_multiplexed_model_id() inside the callable
@@ -107,12 +116,15 @@ class Replica:
                 q: "_queue.Queue" = _queue.Queue(maxsize=16)  # backpressure
                 finished = threading.Event()
 
+                first_chunk_at: List[float] = []
+
                 def finish_stream():
                     if finished.is_set():
                         return
                     finished.set()
                     with self._lock:
                         self._ongoing -= 1
+                        self._m_qdepth.set(self._ongoing)
                     self._streams.pop(stream_id, None)
                     # Stream latency covers first byte to drain completion.
                     self._m_latency.observe((_time.perf_counter() - req_t0) * 1e3)
@@ -122,6 +134,12 @@ class Replica:
                         # No pull for this long = consumer gone (client
                         # disconnect / dropped generator): abandon.
                         q.put(item, timeout=60.0)
+                        if item[0] == "chunk" and not first_chunk_at:
+                            # First chunk produced: the stream's TTFT.
+                            first_chunk_at.append(_time.perf_counter())
+                            self._m_ttft.observe(
+                                (first_chunk_at[0] - req_t0) * 1e3
+                            )
                         return True
                     except _queue.Full:
                         finish_stream()
@@ -150,12 +168,21 @@ class Replica:
                 self._streams[stream_id] = {"q": q, "finish": finish_stream}
                 streaming = True
                 return {self.STREAM_MARKER: stream_id}
+            succeeded = True
             return out
         finally:
             if not streaming:
                 with self._lock:
                     self._ongoing -= 1
-                self._m_latency.observe((_time.perf_counter() - req_t0) * 1e3)
+                    self._m_qdepth.set(self._ongoing)
+                latency_ms = (_time.perf_counter() - req_t0) * 1e3
+                self._m_latency.observe(latency_ms)
+                if succeeded:
+                    # Non-streaming: the whole result IS the first
+                    # result. An errored request produced none — its
+                    # wall time must not pollute the TTFT histogram the
+                    # serve_ttft_p99 SLO rule fires on.
+                    self._m_ttft.observe(latency_ms)
 
     def handle_request_stream(self, method: str, args, kwargs, context=None):
         """Streaming request path: runs as a num_returns="streaming" actor
@@ -170,6 +197,9 @@ class Replica:
         with self._lock:
             self._ongoing += 1
             self._total += 1
+            # Gauge set under the lock: a lost-update race between two
+            # finishing requests would otherwise pin a stale depth.
+            self._m_qdepth.set(self._ongoing)
         self._m_requests.inc()
         req_t0 = _time.perf_counter()
         try:
@@ -208,6 +238,8 @@ class Replica:
                         first = out  # non-generator handler: a one-chunk stream
                 if first is _STREAM_EXHAUSTED:
                     return
+                # First chunk in hand: the streaming path's TTFT.
+                self._m_ttft.observe((_time.perf_counter() - req_t0) * 1e3)
                 yield first
                 if inspect.isasyncgen(out):
                     while True:
@@ -226,6 +258,7 @@ class Replica:
         finally:
             with self._lock:
                 self._ongoing -= 1
+                self._m_qdepth.set(self._ongoing)
             self._m_latency.observe((_time.perf_counter() - req_t0) * 1e3)
 
     def next_chunks(self, stream_id: str, max_n: int = 8, timeout: float = 2.0):

@@ -7,7 +7,15 @@ groups; in-program psum replaces DDP allreduce.
 
 from .checkpoint import Checkpoint, CheckpointManager, StorageContext, load_pytree, save_pytree
 from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
-from .session import drain_requested, get_checkpoint, get_context, get_session, report
+from .session import (
+    configure_telemetry,
+    drain_requested,
+    get_checkpoint,
+    get_context,
+    get_session,
+    phase,
+    report,
+)
 from .trainer import JaxTrainer, Result
 from .worker_group import WorkerGroup
 
@@ -23,6 +31,7 @@ def get_mesh():
 __all__ = [
     "Checkpoint", "CheckpointManager", "StorageContext", "load_pytree",
     "save_pytree", "CheckpointConfig", "FailureConfig", "RunConfig",
-    "ScalingConfig", "drain_requested", "get_checkpoint", "get_context",
-    "get_session", "report", "JaxTrainer", "Result", "WorkerGroup", "get_mesh",
+    "ScalingConfig", "configure_telemetry", "drain_requested",
+    "get_checkpoint", "get_context", "get_session", "phase", "report",
+    "JaxTrainer", "Result", "WorkerGroup", "get_mesh",
 ]

@@ -10,8 +10,10 @@ lockstep and bounding memory.
 
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
+import time as _time
 from typing import Any, Dict, Optional
 
 from .checkpoint import Checkpoint
@@ -52,9 +54,16 @@ class TrainSession:
         self._cancelled = threading.Event()
         self._drain = threading.Event()
         self._last_report_ts: Optional[float] = None
+        # Efficiency telemetry (configure_telemetry): model FLOPs for the
+        # MFU computation + per-step phase-time accumulators.
+        self._flops_per_token: Optional[float] = None
+        self._peak_flops: Optional[float] = None
+        self._phase_seconds: Dict[str, float] = {}
+        self._phase_lock = threading.Lock()
 
     # ------------------------------------------------------------ user API
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+        metrics = self._enrich_metrics(metrics)
         self._observe_report(metrics)
         payload = {"metrics": dict(metrics), "checkpoint": checkpoint}
         while True:
@@ -66,14 +75,71 @@ class TrainSession:
             except queue.Full:
                 continue
 
+    def configure_telemetry(
+        self,
+        flops_per_token: Optional[float] = None,
+        peak_flops_per_s: Optional[float] = None,
+    ) -> None:
+        """Arms MFU computation: with `flops_per_token` (e.g. from
+        models/transformer.py:flops_per_token) every report carrying
+        `tokens_per_s` gains an `mfu` metric, against `peak_flops_per_s`
+        or the autodetected device peak (observability/goodput.py)."""
+        if flops_per_token is not None:
+            self._flops_per_token = float(flops_per_token)
+        if peak_flops_per_s is not None:
+            self._peak_flops = float(peak_flops_per_s)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """Marks a step phase (data_wait / compute / allreduce / ...):
+        duration lands in the raytpu_train_phase_time_ms histogram, a
+        tracing span (when tracing is on), and the per-step
+        `phase_seconds` breakdown attached to the next report."""
+        from .. import tracing
+        from ..utils import internal_metrics as imet
+
+        t0 = _time.perf_counter()
+        try:
+            with tracing.maybe_span(f"train.phase.{name}", {"phase": name}):
+                yield
+        finally:
+            dt = _time.perf_counter() - t0
+            imet.TRAIN_PHASE_TIME.observe(dt * 1e3, phase=name)
+            with self._phase_lock:
+                self._phase_seconds[name] = self._phase_seconds.get(name, 0.0) + dt
+
+    def _enrich_metrics(self, metrics: Dict[str, Any]) -> Dict[str, Any]:
+        """Derived efficiency metrics folded into the user's report: MFU
+        (when configure_telemetry armed it and tokens_per_s is present)
+        and the per-step phase breakdown (reset each report)."""
+        out = dict(metrics)
+        tps = out.get("tokens_per_s")
+        if (
+            "mfu" not in out
+            and isinstance(tps, (int, float))
+            and self._flops_per_token
+        ):
+            from ..observability import goodput as _goodput
+
+            value = _goodput.mfu(
+                float(tps), self._flops_per_token, self._peak_flops
+            )
+            if value is not None:
+                out["mfu"] = value
+        with self._phase_lock:
+            if self._phase_seconds and "phase_seconds" not in out:
+                out["phase_seconds"] = {
+                    k: round(v, 6) for k, v in self._phase_seconds.items()
+                }
+            self._phase_seconds = {}
+        return out
+
     def _observe_report(self, metrics: Dict[str, Any]) -> None:
         """Internal train telemetry: report-to-report interval is the step
         time of the training loop, and recognized throughput keys
         (tokens_per_s, mfu) mirror into cluster gauges so `/metrics` shows
         pod saturation without user-defined metrics (PAPERS: Podracer /
         pjit-at-scale both steer on step-time + MFU)."""
-        import time as _time
-
         from ..utils import internal_metrics as imet
 
         now = _time.monotonic()
@@ -195,6 +261,33 @@ def drain_requested() -> bool:
     TrainSession.drain_requested."""
     s = get_session()
     return s.drain_requested() if s else False
+
+
+def phase(name: str):
+    """Step-phase marker for the training loop:
+
+        with train.phase("data_wait"):
+            batch = next(it)
+        with train.phase("compute"):
+            loss, grads = step(params, batch)
+        with train.phase("allreduce"):
+            grads = psum_grads(grads)
+
+    Durations land in the raytpu_train_phase_time_ms histogram (by
+    phase tag), tracing spans, and the next report's `phase_seconds`
+    breakdown. A no-op outside a session."""
+    s = get_session()
+    return s.phase(name) if s else contextlib.nullcontext()
+
+
+def configure_telemetry(
+    flops_per_token: Optional[float] = None,
+    peak_flops_per_s: Optional[float] = None,
+) -> None:
+    """See TrainSession.configure_telemetry. No-op outside a session."""
+    s = get_session()
+    if s is not None:
+        s.configure_telemetry(flops_per_token, peak_flops_per_s)
 
 
 class TrainContext:

@@ -26,6 +26,7 @@ from .. import api
 from .. import exceptions as exc
 from ..core import runtime_base
 from ..core.placement_group import placement_group as create_pg
+from ..observability import goodput as _goodput
 from ..observability.flight_recorder import record as _flight_record
 from ..utils import internal_metrics as imet
 from ..utils import node_events
@@ -111,10 +112,17 @@ class JaxTrainer:
         resume_ckpt = self._resume_from
         last_error: Optional[BaseException] = None
         metrics: Dict[str, Any] = {}
+        # Goodput ledger: fit() is the one supervisor that sees every
+        # lifecycle transition, so it owns the category switches
+        # (observability/goodput.py). Public for inspection/tests.
+        self.goodput = _goodput.GoodputAccountant()
+        restored = False  # next attempt recomputes lost steps first
 
         while True:
             try:
-                metrics = self._run_attempt(storage, manager, resume_ckpt)
+                metrics = self._run_attempt(
+                    storage, manager, resume_ckpt, rework=restored
+                )
                 last_error = None
                 break
             except (KeyboardInterrupt, SystemExit):
@@ -133,10 +141,13 @@ class JaxTrainer:
                     break
                 if resume_ckpt is not None:
                     imet.CHECKPOINTS_RESTORED.inc()
+                restored = True
                 _flight_record(
                     "train.restore",
                     (resume_ckpt.path if resume_ckpt else None, preemptions),
                 )
+                # Waiting out replacement capacity is drain-wait time.
+                self.goodput.begin(_goodput.DRAIN_WAIT)
                 self._wait_for_capacity()
             except Exception as e:  # noqa: BLE001
                 last_error = e
@@ -149,8 +160,18 @@ class JaxTrainer:
                     break
                 if resume_ckpt is not None:
                     imet.CHECKPOINTS_RESTORED.inc()
+                    restored = True
                     _flight_record("train.restore", (resume_ckpt.path, attempt))
 
+        self.goodput.finish()
+        snap = self.goodput.snapshot()
+        metrics = dict(metrics)
+        metrics["goodput"] = snap["goodput"]
+        metrics["goodput_seconds"] = snap["seconds"]
+        # once=True: the terminal value ships on one flush and then stops
+        # re-reporting — a finished run's low goodput must not pin the
+        # goodput_floor alert for the life of the driver process.
+        imet.TRAIN_GOODPUT.set(snap["goodput"], once=True, trial=name)
         storage.write_json(
             "result.json",
             {"metrics": metrics, "error": repr(last_error) if last_error else None},
@@ -215,8 +236,18 @@ class JaxTrainer:
         storage: StorageContext,
         manager: CheckpointManager,
         resume_ckpt: Optional[Checkpoint],
+        rework: bool = False,
     ) -> Dict[str, Any]:
         import cloudpickle
+
+        # Until the first fresh result lands, this attempt's wall time is
+        # either setup (first attempt) or restart-rework (re-reaching the
+        # restored step after a failure/preemption — work the cluster
+        # already did once).
+        acct = getattr(self, "goodput", None)
+        if acct is None:  # direct _run_attempt callers (tests)
+            acct = self.goodput = _goodput.GoodputAccountant()
+        acct.begin(_goodput.RESTART_REWORK if rework else _goodput.SETUP)
 
         sc = self.scaling_config
         pg = None
@@ -313,6 +344,10 @@ class JaxTrainer:
                         # checkpoint). Results keep flowing below so the
                         # final checkpoint is captured before the raise.
                         _flight_record("train.drain", tuple(sorted(drained)))
+                        # From the notice on, wall time serves the
+                        # preemption (final checkpoint, teardown), not
+                        # fresh steps.
+                        acct.begin(_goodput.DRAIN_WAIT)
                         for w in group.workers:
                             try:
                                 w.request_drain.remote()
@@ -349,6 +384,10 @@ class JaxTrainer:
                 ]
                 if not live:
                     continue  # every worker is mid-step; poll again
+                if not drained and acct.category != _goodput.PRODUCTIVE:
+                    # First fresh result of this attempt: steps are
+                    # advancing — setup/rework ends here.
+                    acct.begin(_goodput.PRODUCTIVE)
                 rank0 = (
                     results[0]
                     if results[0] is not None and not results[0].get("__pending__")
@@ -357,9 +396,20 @@ class JaxTrainer:
                 self._last_metrics = dict(rank0["metrics"])
                 ckpt_path = rank0.get("checkpoint")
                 if ckpt_path:
+                    if not drained:
+                        acct.begin(_goodput.CHECKPOINT)
                     persisted = storage.persist_checkpoint(Checkpoint(ckpt_path), ckpt_index)
                     manager.register(persisted, self._last_metrics)
                     ckpt_index += 1
+                    if not drained:
+                        acct.begin(_goodput.PRODUCTIVE)
+                    # Live goodput gauge each checkpoint: the
+                    # goodput_floor watchdog is about runs IN PROGRESS
+                    # (fit()'s terminal set is one-shot).
+                    imet.TRAIN_GOODPUT.set(
+                        acct.fraction(),
+                        trial=storage.trial_name or storage.experiment_name,
+                    )
 
             try:
                 api.get([w.join.remote() for w in group.workers])

@@ -142,21 +142,30 @@ class _BoundCounter:
 
 
 class _BoundGauge:
-    __slots__ = ("_value", "_set")
+    __slots__ = ("_value", "_set", "_once")
 
     def __init__(self):
         self._value = 0.0
         self._set = False
+        self._once = False
 
-    def set(self, value: float) -> None:
+    def set(self, value: float, once: bool = False) -> None:
+        """`once=True` ships the value on exactly one flush and then
+        stops re-reporting: the terminal value of a finished run (e.g. a
+        final goodput) must not be re-asserted by the driver's flusher
+        forever — the GCS prunes the stale gauge ~30 s later and history
+        windows age the sample out, so alerts on it can clear."""
         if not _enabled:
             return
         self._value = float(value)
         self._set = True
+        self._once = bool(once)
 
     def _delta(self) -> Optional[dict]:
         if not self._set:
             return None
+        if self._once:
+            self._set = False
         return {"value": self._value}
 
 
@@ -298,8 +307,8 @@ class Gauge(InternalMetric):
     def _make_bound(self):
         return _BoundGauge()
 
-    def set(self, value: float, **tags: str) -> None:
-        self.labels(**tags).set(value)
+    def set(self, value: float, once: bool = False, **tags: str) -> None:
+        self.labels(**tags).set(value, once=once)
 
 
 class Histogram(InternalMetric):
@@ -573,6 +582,18 @@ SERVE_REQUEST_LATENCY = Histogram(
     component="serve",
     tag_keys=("deployment",),
 )
+SERVE_TTFT = Histogram(
+    "raytpu_serve_ttft_ms",
+    "Serve time to first result/chunk (replica-side), by deployment",
+    component="serve",
+    tag_keys=("deployment",),
+)
+SERVE_QUEUE_DEPTH = Gauge(
+    "raytpu_serve_queue_depth",
+    "In-flight requests on this replica (streams count until drained)",
+    component="serve",
+    tag_keys=("deployment",),
+)
 DATA_OP_TASKS = Counter(
     "raytpu_data_op_tasks_total",
     "Data streaming-executor tasks submitted, by operator",
@@ -614,6 +635,19 @@ TRAIN_MFU = Gauge(
     component="train",
     tag_keys=("trial", "rank"),
 )
+TRAIN_PHASE_TIME = Histogram(
+    "raytpu_train_phase_time_ms",
+    "Per-step training phase durations (train.phase: data_wait / compute / allreduce / ...)",
+    component="train",
+    boundaries=[0.5, 1, 5, 10, 50, 100, 500, 1000, 5000, 10000, 60000],
+    tag_keys=("phase",),
+)
+TRAIN_GOODPUT = Gauge(
+    "raytpu_train_goodput",
+    "Goodput fraction: productive step time / total wall time of the run",
+    component="train",
+    tag_keys=("trial",),
+)
 RL_ENV_STEPS = Counter(
     "raytpu_rl_env_steps_total",
     "Environment steps sampled by env runners",
@@ -654,6 +688,12 @@ CHAOS_INJECTIONS = Counter(
     "Faults injected by the chaos controller, by point and action",
     component="chaos",
     tag_keys=("point", "action"),
+)
+NODE_HEARTBEAT_LAG = Gauge(
+    "raytpu_node_heartbeat_lag_s",
+    "Seconds since each alive node's last raylet heartbeat (GCS-reported)",
+    component="gcs",
+    tag_keys=("node",),
 )
 
 

@@ -5,7 +5,11 @@ raylet/GCS whose work happens on RPC server threads. This sampler walks
 ``sys._current_frames()`` on an interval and aggregates truncated stacks —
 the same approach as external samplers (py-spy) but in-process and
 dependency-free. Enable per-daemon with RAY_TPU_SAMPLING_PROFILE=<dir>;
-each process writes <dir>/<name>-<pid>.txt at exit, hottest stacks first.
+each process writes <dir>/<name>-<pid>.txt at exit, hottest stacks first,
+plus a structured ``profile_*.json`` twin that `ray-tpu trace` merges
+into the Perfetto timeline (observability/perfetto.py). On-demand:
+`ray-tpu debug profile --seconds N` asks every raylet to sample itself
+for N seconds via the `profile` RPC.
 (reference: the reference ships cProfile/py-spy hooks via
 ray._private.profiling and the dashboard's flame-graph endpoint.)
 """
@@ -14,12 +18,26 @@ from __future__ import annotations
 
 import atexit
 import collections
+import json
 import os
 import sys
 import threading
+import time as _time
 from typing import Optional
 
 _DEPTH = 5
+
+
+def profile_dir() -> str:
+    """Where structured profile dumps land: RAY_TPU_SAMPLING_PROFILE when
+    set, else <trace_dir>/profile — parallel to the flight dir so one
+    `ray-tpu trace` sweep finds spans, flight rings, AND profiles."""
+    d = os.environ.get("RAY_TPU_SAMPLING_PROFILE")
+    if d:
+        return d
+    from .. import tracing
+
+    return os.path.join(tracing.trace_dir(), "profile")
 
 
 class SamplingProfiler:
@@ -76,10 +94,52 @@ class SamplingProfiler:
             for stack, n in self.counts.most_common(100):
                 f.write(f"{n}\t{stack}\n")
 
+    def dump_json(self, path: Optional[str] = None, name: str = "proc") -> str:
+        """Structured dump for the Perfetto merge: aggregated hottest
+        stacks with counts. Tmp+rename so a killed daemon never leaves a
+        truncated file for the merger."""
+        if path is None:
+            d = profile_dir()
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"profile_{name}-{os.getpid()}_{_time.time_ns() // 1000}.json"
+            )
+        payload = {
+            "pid": os.getpid(),
+            "name": name,
+            "interval_s": self.interval_s,
+            "samples": self.samples,
+            "dump_us": _time.time_ns() // 1000,
+            "stacks": [[n, stack] for stack, n in self.counts.most_common(100)],
+        }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        return path
+
+
+def run_for(seconds: float, name: str = "proc") -> dict:
+    """Blocking on-demand profile (the raylet `profile` RPC body): sample
+    this process for `seconds`, dump text + JSON, return their paths."""
+    seconds = min(max(float(seconds), 0.2), 60.0)
+    prof = SamplingProfiler()
+    prof.start()
+    _time.sleep(seconds)
+    prof.stop()
+    json_path = prof.dump_json(name=name)
+    txt_path = json_path[: -len(".json")] + ".txt"
+    try:
+        prof.dump(txt_path)
+    except OSError:
+        txt_path = None
+    return {"path": json_path, "text": txt_path, "samples": prof.samples}
+
 
 def maybe_start_from_env(name: str) -> Optional[SamplingProfiler]:
     """Starts a sampler when RAY_TPU_SAMPLING_PROFILE is set to a directory;
-    dumps to <dir>/<name>-<pid>.txt at process exit."""
+    dumps to <dir>/<name>-<pid>.txt (+ a structured .json twin for the
+    trace merge) at process exit."""
     out_dir = os.environ.get("RAY_TPU_SAMPLING_PROFILE")
     if not out_dir:
         return None
@@ -88,5 +148,19 @@ def maybe_start_from_env(name: str) -> Optional[SamplingProfiler]:
     path = os.path.join(out_dir, f"{name}-{os.getpid()}.txt")
     prof._path = path
     prof.start()
-    atexit.register(lambda: (prof.stop(), prof.dump(path)))
+
+    def _final_dump():
+        prof.stop()
+        prof.dump(path)
+        try:
+            prof.dump_json(
+                path=os.path.join(
+                    out_dir, f"profile_{name}-{os.getpid()}.json"
+                ),
+                name=name,
+            )
+        except OSError:
+            pass
+
+    atexit.register(_final_dump)
     return prof

@@ -73,6 +73,34 @@ def internal_metrics() -> List[Dict[str, Any]]:
     return _gcs().call("internal_metrics")
 
 
+def metrics_history(
+    name: Optional[str] = None,
+    tags: Optional[Dict[str, str]] = None,
+    window_s: Optional[float] = None,
+    as_rate: bool = False,
+) -> List[Dict[str, Any]]:
+    """Time-series history of the internal metrics: matching series with
+    `samples` lists of [ts, value] ([ts, count, sum] for histograms) —
+    fine-resolution recent samples plus coarse rollups of older ones
+    (observability/history.py). `tags` is a subset filter; `as_rate`
+    converts cumulative series to per-second rates, so
+
+        state.metrics_history("raytpu_store_puts_total",
+                              window_s=60, as_rate=True)
+
+    is puts/s over the last minute per (component, node) series. Empty
+    when retention is disabled (RAY_TPU_METRICS_HISTORY=0)."""
+    return _gcs().call("metrics_history", name, tags, window_s, as_rate)
+
+
+def active_alerts() -> List[Dict[str, Any]]:
+    """Currently-firing SLO watchdog alerts (observability/watchdog.py):
+    rule name, metric, observed value vs threshold, firing-since. Alert
+    transitions are also published on the `node_events` pubsub channel
+    and flight-recorded."""
+    return _gcs().call("active_alerts")
+
+
 def get_task(task_id: str) -> Optional[Dict[str, Any]]:
     return _gcs().call("get_task_states", [task_id]).get(task_id)
 

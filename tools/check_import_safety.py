@@ -58,7 +58,11 @@ REQUIRED = {
     "ray_tpu.observability",
     "ray_tpu.observability.flight_recorder",
     "ray_tpu.observability.perfetto",
+    "ray_tpu.observability.history",
+    "ray_tpu.observability.watchdog",
+    "ray_tpu.observability.goodput",
     "ray_tpu.tracing",
+    "ray_tpu.utils.sampling_profiler",
     # The chaos controller imports into every worker/raylet (its
     # injection points live on the task/channel/collective hot paths);
     # a backend init here would wedge the cluster with chaos DISARMED.
