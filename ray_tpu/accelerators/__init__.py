@@ -83,7 +83,16 @@ def _load_plugin(spec: str) -> None:
     except Exception as e:  # a broken plugin must not brick node startup
         import sys
 
-        print(
+        from ..observability.logs import get_logger
+
+        get_logger("accelerators").warning(
+            "plugin %r failed to load: %r", spec, e
+        )
+        # Also straight to stderr: this is a USER misconfiguration, and in
+        # a driver process the structured record has no console path — a
+        # silently-unregistered accelerator would surface only as
+        # mysterious scheduling failures.
+        print(  # console-output: plugin misconfiguration must reach the user
             f"ray_tpu.accelerators: plugin {spec!r} failed to load: {e!r}",
             file=sys.stderr,
         )

@@ -177,13 +177,12 @@ class GraphExecutor:
                 # to the driver, so record the real cause where an operator
                 # can find it before breaking — and dump the flight ring:
                 # the last recorded events name the node/channel involved.
-                import sys
+                from ..observability.logs import get_logger
 
-                print(
-                    f"[cgraph {self.plan['dag_id'][:8]}] exec loop died:\n"
-                    f"{traceback.format_exc()}",
-                    file=sys.stderr,
-                    flush=True,
+                get_logger("cgraph").error(
+                    "[cgraph %s] exec loop died:\n%s",
+                    self.plan["dag_id"][:8],
+                    traceback.format_exc(),
                 )
                 _frec.dump(
                     reason=f"cgraph exec loop crash (dag {dag8}, seq {seq})"

@@ -228,6 +228,17 @@ class ChaosController:
             imet.CHAOS_INJECTIONS.inc(point=point, action=rule.action)
         except Exception:
             pass  # metrics must never break the injection itself
+        try:
+            # The structured log stream gets the injection too: `ray-tpu
+            # logs --component chaos` shows a campaign's faults inline
+            # with the symptoms they caused.
+            from ..observability.logs import get_logger
+
+            get_logger("chaos").warning(
+                "injecting %s at %s (%s)", rule.action, point, detail
+            )
+        except Exception:
+            pass
 
     def stats(self) -> List[Dict[str, Any]]:
         with self._lock:

@@ -215,20 +215,31 @@ class ClusterRuntime(Runtime):
         # the memory store even before adoption into _owned.
         self._stream_tasks: set = set()
         self._renv_cache: Dict[str, dict] = {}
-        # Stream worker stdout/stderr to the driver console (reference:
-        # log_monitor.py tailing worker logs to the driver; disable with
-        # RAY_TPU_LOG_TO_DRIVER=0). Remote clients (tcp:// raylet, no
-        # session dir) have no local log files to tail — skip the thread.
+        # Structured logging: the driver's own records land in the
+        # session's log dir (observability/logs.py), and captured worker
+        # output arrives over the `logs` pubsub channel for attributed
+        # re-printing (reference: log_monitor.py streaming worker logs to
+        # the driver; disable with RAY_TPU_LOG_TO_DRIVER=0).
         self._log_session = session_dir or (
             None if raylet.path.startswith("tcp://") else os.path.dirname(raylet.path)
         )
-        if (
-            driver
-            and self._log_session
-            and os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0"
-        ):
+        from ..observability import logs as _logs
+
+        if driver:
+            _logs.configure(
+                "driver",
+                node_id=node_id,
+                directory=(
+                    os.path.join(self._log_session, "logs")
+                    if self._log_session
+                    else None
+                ),
+            )
+        self._log_printer = _logs.DedupPrinter()
+        self._log_recent: List[str] = []  # last re-printed lines (tests/bench)
+        if driver and os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
             threading.Thread(
-                target=self._stream_logs, daemon=True, name="logmon"
+                target=self._log_subscriber, daemon=True, name="logmon"
             ).start()
 
     def _fast_register(self, entry: dict) -> None:
@@ -284,54 +295,54 @@ class ClusterRuntime(Runtime):
             ):
                 self._fast_seal_cv.notify_all()
 
-    def _stream_logs(self) -> None:
-        log_dir = os.path.join(self._log_session, "logs")
-        offsets: Dict[str, int] = {}
-        # Stream only output produced AFTER this driver attached: replaying
-        # a long-lived cluster's history (or other jobs' output) floods the
-        # console (reference: log_monitor.py streams from attach time).
-        try:
-            for name in os.listdir(log_dir):
-                path = os.path.join(log_dir, name)
-                try:
-                    offsets[name] = os.path.getsize(path)
-                except OSError:
-                    pass
-        except OSError:
-            pass
-        while not self._shutdown_done:
-            time.sleep(0.5)
+    def _log_subscriber(self) -> None:
+        """Re-prints captured worker output at the driver with
+        `(ActorName pid=... node=...)` prefixes. Source is the `logs`
+        pubsub channel the raylet log monitors publish on — works across
+        hosts and for remote clients, unlike tailing local files.
+        Identical repeated lines are deduped and the stream is
+        rate-limited (logs.DedupPrinter) so a hot-loop actor cannot
+        freeze the driver console."""
+        from ..observability import logs as _logs
+
+        # Position at the channel tail: output from BEFORE this driver
+        # attached belongs to earlier jobs, not this console. A failed
+        # positioning call must NOT fall back to cursor 0 — that would
+        # replay a long-lived cluster's whole retained history the moment
+        # the GCS recovers — so retry until it succeeds.
+        cursor = None
+        while cursor is None and not self._shutdown_done:
             try:
-                names = sorted(os.listdir(log_dir))
-            except OSError:
+                entries = self._gcs.call(
+                    "pubsub_poll", "logs", 0, 0.0, timeout=10.0
+                )
+                cursor = entries[-1][0] if entries else 0
+            except Exception:
+                time.sleep(0.5)
+        if cursor is None:
+            return
+        printer = self._log_printer
+        while not self._shutdown_done:
+            try:
+                entries = self._gcs.call(
+                    "pubsub_poll", "logs", cursor, 1.0, timeout=11.0
+                )
+            except Exception:
+                if self._shutdown_done:
+                    return
+                time.sleep(0.5)
                 continue
-            for name in names:
-                if not name.startswith("worker_"):
+            for seq, msg in entries:
+                cursor = max(cursor, seq)
+                if not isinstance(msg, dict):
                     continue
-                path = os.path.join(log_dir, name)
-                try:
-                    size = os.path.getsize(path)
-                except OSError:
-                    continue
-                pos = offsets.get(name, 0)
-                if size <= pos:
-                    continue
-                try:
-                    with open(path, "rb") as f:
-                        f.seek(pos)
-                        data = f.read(size - pos)
-                except OSError:
-                    continue
-                # Consume only whole lines: a write landing mid-poll would
-                # otherwise print as two fragments (and could split a
-                # multibyte character).
-                cut = data.rfind(b"\n")
-                if cut < 0:
-                    continue  # partial line: wait for the newline
-                offsets[name] = pos + cut + 1
-                tag = name.rsplit(".", 1)[0]
-                for line in data[: cut + 1].decode(errors="replace").splitlines():
-                    print(f"({tag}) {line}", flush=True)
+                prefix = _logs.capture_prefix(msg)
+                for line in msg.get("lines") or ():
+                    printer.emit(prefix, line)
+                    self._log_recent.append(f"{prefix} {line}")
+                if len(self._log_recent) > 1000:
+                    del self._log_recent[:-500]
+            printer.flush()
 
     # ------------------------------------------------------------ factory
     @classmethod

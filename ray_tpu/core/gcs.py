@@ -80,6 +80,10 @@ class GcsService:
             if _history_mod.history_enabled()
             else None
         )
+        # Cluster error reports (uncaught worker exceptions, crashes):
+        # bounded ring fed by `report_error`, mirrored on the
+        # `error_reports` pubsub channel.
+        self._errors: List[dict] = []
         # General pubsub channels: name -> [(seq, message)] (bounded).
         self._pubsub: Dict[str, List[Tuple[int, Any]]] = {}
         self._pubsub_total = 0  # running entry count across channels
@@ -1200,6 +1204,34 @@ class GcsService:
                     return []
                 self._pubsub_cv.wait(timeout=min(remaining, 1.0))
 
+    # ------------------------------------------------------ error reports
+    # Cluster error table (reference: the error pubsub surfacing uncaught
+    # worker exceptions at the driver, _private/utils.py publish_error_to
+    # _driver + util/state list_cluster_events): workers report uncaught
+    # task exceptions, raylets report worker crashes (with the dying
+    # process's captured-output tail). Bounded ring + `error_reports`
+    # pubsub channel; `state.cluster_errors()` / `ray-tpu status` read it.
+    _ERRORS_RETAIN = 256
+
+    def report_error(self, payload: dict) -> bool:
+        if not isinstance(payload, dict):
+            return False
+        payload = dict(payload)
+        payload.setdefault("ts", time.time())
+        with self._lock:
+            self._errors.append(payload)
+            del self._errors[: -self._ERRORS_RETAIN]
+        imet.ERROR_REPORTS.inc()
+        try:
+            self.pubsub_publish("error_reports", payload)
+        except Exception:
+            pass
+        return True
+
+    def cluster_errors(self, limit: int = 100) -> List[dict]:
+        with self._lock:
+            return list(self._errors)[-limit:]
+
     # ------------------------------------------------------ placement grp
     def _plan_bundles(
         self, bundles: List[dict], strategy: str, banned: Set[str]
@@ -1562,6 +1594,16 @@ def main(
     for the whole cluster)."""
     from .rpc import RpcServer
 
+    import os
+
+    from ..observability import logs as _logs
+
+    _logs.configure(
+        "gcs",
+        node_id="gcs",
+        directory=os.path.join(os.path.dirname(sock_path) or ".", "logs"),
+    )
+    _logs.get_logger("gcs").info("gcs daemon started (pid %d)", os.getpid())
     service = GcsService(snapshot_path=snapshot_path or sock_path + ".snapshot")
     # The GCS's own internal metrics merge straight into its table — no
     # self-RPC loop (reference: the head metrics agent scraping itself).
@@ -1574,7 +1616,7 @@ def main(
     tcp_server = RpcServer(tcp_address, service) if tcp_address else None
     if tcp_server is not None:
         # The bound address (ephemeral ports resolved) for the bootstrapper.
-        print(f"GCS_TCP_ADDRESS={tcp_server.address}", flush=True)
+        print(f"GCS_TCP_ADDRESS={tcp_server.address}", flush=True)  # console-output: bootstrap protocol read by _read_announced
     try:
         while not service._stop.wait(0.5):
             pass

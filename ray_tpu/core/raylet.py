@@ -34,6 +34,7 @@ from .. import tracing as _tracing
 from ..chaos.controller import kill_now as _chaos_kill
 from ..chaos.controller import maybe_inject as _chaos_inject
 from ..observability.flight_recorder import record as _flight_record
+from ..observability.logs import get_logger as _get_logger
 from ..utils import internal_metrics as imet
 from ..utils.config import CONFIG
 from .ids import ObjectID
@@ -43,6 +44,8 @@ from .rpc import RpcClient, RpcServer
 from .shm_store import SharedMemoryStore
 
 POLL_TIMEOUT_S = CONFIG.worker_poll_timeout_s
+
+_log = _get_logger("raylet")
 
 
 class _Worker:
@@ -238,6 +241,18 @@ class RayletService:
             threading.Thread(target=self._monitor_loop, daemon=True, name="monitor"),
             threading.Thread(target=self._flush_loop, daemon=True, name="flush"),
         ]
+        if os.environ.get("RAY_TPU_LOG_MONITOR", "1") != "0":
+            # Log monitor (reference: log_monitor.py): tails this node's
+            # captured worker stdout/stderr, publishes new lines on the
+            # `logs` pubsub channel (the driver re-prints them with
+            # attribution prefixes), and mirrors them into structured
+            # capture records so `ray-tpu logs --actor ...` finds raw
+            # prints too.
+            self._threads.append(
+                threading.Thread(
+                    target=self._log_monitor_loop, daemon=True, name="logmon"
+                )
+            )
         reg = self.gcs.call(
             # self.total, not the raw arg: the visible-chip clamp above must
             # be what the cluster schedules against (heartbeat re-register
@@ -1337,7 +1352,7 @@ class RayletService:
         if lease is not None:
             self._release(lease["resources"])
         if os.environ.get("RAY_TPU_DEBUG_DIRECT") == "1":
-            print(f"[raylet] lease returned by {worker_id[:6]}", file=sys.stderr, flush=True)
+            _log.info("lease returned by %s", worker_id[:6])
         with self._workers_lock:
             w = self._workers.get(worker_id)
             if (
@@ -1365,7 +1380,7 @@ class RayletService:
             return
         self._last_reclaim = now
         if os.environ.get("RAY_TPU_DEBUG_DIRECT") == "1":
-            print(f"[raylet] reclaim check: leases={list(self._leases)}", file=sys.stderr, flush=True)
+            _log.info("reclaim check: leases=%s", list(self._leases))
         victims: List[str] = []
         for wid, lease in list(self._leases.items()):
             if now - lease.get("granted_at", 0.0) < 0.25:
@@ -1513,6 +1528,186 @@ class RayletService:
         return sampling_profiler.run_for(
             seconds, name=f"raylet-{self.node_id[:12]}"
         )
+
+    # -------------------------------------------------------------- logs
+    _TAIL_FILTER_KEYS = (
+        "component",
+        "level",
+        "task_id",
+        "actor_id",
+        "trace_id",
+        "worker_id",
+        "node_id",
+        "grep",
+        "since_ts",
+    )
+
+    def tail_logs(self, filters: Optional[dict] = None) -> List[dict]:
+        """Filtered structured log records from this node's session log
+        dir (`ray-tpu logs` fans this out cluster-wide). Raw worker
+        prints appear too: the log monitor mirrors captured stdout/stderr
+        lines into capture records with worker/actor attribution."""
+        from ..observability import logs as _logs
+
+        filters = dict(filters or {})
+        tail = filters.pop("tail", 1000)
+        clean = {
+            k: v for k, v in filters.items() if k in self._TAIL_FILTER_KEYS
+        }
+        return _logs.read_records(self._log_dir, tail=tail, **clean)
+
+    def _worker_attribution(self, worker_id: str) -> Tuple[Optional[int], Optional[str], Optional[str]]:
+        """(pid, actor_id, actor_name) for one worker — the identity the
+        capture path stamps onto its output lines."""
+        with self._workers_lock:
+            w = self._workers.get(worker_id)
+        pid = getattr(getattr(w, "proc", None), "pid", None) if w else None
+        aid = w.actor_id if w else None
+        name = None
+        if aid:
+            with self._actor_lock:
+                a = self._actors.get(aid)
+                entry = (a or {}).get("creation_entry") or {}
+            name = entry.get("name") or f"Actor({aid[:8]})"
+        return pid, aid, name
+
+    def _log_monitor_loop(self) -> None:
+        """Tails worker_*.out / worker_*.err under the node's log dir:
+        complete new lines are (1) published on the `logs` pubsub channel
+        for the driver's attributed re-print and (2) re-logged as
+        structured capture records (component stdout/stderr, the ORIGIN
+        worker's ids attached) so the query paths see raw prints."""
+        from ..observability import logs as _logs
+
+        offsets: Dict[str, int] = {}
+        while not self._stop.wait(0.2):
+            try:
+                names = sorted(os.listdir(self._log_dir))
+            except OSError:
+                continue
+            for name in names:
+                if not (
+                    name.startswith("worker_")
+                    and (name.endswith(".out") or name.endswith(".err"))
+                ):
+                    continue
+                path = os.path.join(self._log_dir, name)
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    offsets.pop(name, None)
+                    continue
+                pos = offsets.get(name, 0)
+                if pos > size:
+                    pos = 0  # file truncated/replaced: start over
+                if size <= pos:
+                    continue
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(pos)
+                        data = f.read(size - pos)
+                except OSError:
+                    continue
+                cut = data.rfind(b"\n")
+                if cut < 0:
+                    continue  # no complete line yet
+                offsets[name] = pos + cut + 1
+                lines = data[: cut + 1].decode(errors="replace").splitlines()
+                if not lines:
+                    continue
+                stream = name.rsplit(".", 1)[1]
+                wid = name[len("worker_"): -len(".out")]
+                pid, aid, actor_name = self._worker_attribution(wid)
+                now = time.time()
+                _logs.write_capture_records(
+                    [
+                        _logs.capture_record(
+                            line, stream, self.node_id, wid, aid, pid, ts=now
+                        )
+                        for line in lines
+                    ]
+                )
+                imet.LOG_LINES_PUBLISHED.inc(len(lines))
+                # Chunked publish: one pubsub message must stay small
+                # enough for the bounded retention window to hold a burst
+                # from several workers at once.
+                for i in range(0, len(lines), 200):
+                    msg = {
+                        "node_id": self.node_id,
+                        "worker_id": wid,
+                        "pid": pid,
+                        "actor": actor_name,
+                        "stream": stream,
+                        "lines": lines[i: i + 200],
+                    }
+                    try:
+                        self.gcs.notify("pubsub_publish", "logs", msg)
+                    except Exception:
+                        break  # GCS unreachable; lines stay on disk
+            # Retention GC rides the monitor cadence, throttled to ~10 s.
+            # Live workers' files (plus this node's daemons') are
+            # protected: their writers hold the fds open, and an unlink
+            # would silently void all their future output.
+            now = time.monotonic()
+            if now - getattr(self, "_last_log_gc", 0.0) > 10.0:
+                self._last_log_gc = now
+                try:
+                    with self._workers_lock:
+                        live = [f"worker_{wid}" for wid in self._workers]
+                    _logs.gc_log_dir(
+                        self._log_dir,
+                        protect_prefixes=live + ["gcs", "raylet_", "zygote"],
+                    )
+                except Exception:
+                    pass
+
+    def _worker_log_tail(self, worker_id: str, n_lines: int = 50) -> str:
+        """The last captured output lines of one worker (its .out/.err
+        files) — the crash-postmortem tail appended to TaskError/actor
+        death messages and written next to the flight dumps."""
+        chunks: List[str] = []
+        for ext in (".err", ".out"):
+            path = os.path.join(self._log_dir, f"worker_{worker_id}{ext}")
+            try:
+                size = os.path.getsize(path)
+                with open(path, "rb") as f:
+                    f.seek(max(0, size - 16384))
+                    data = f.read()
+            except OSError:
+                continue
+            lines = data.decode(errors="replace").splitlines()[-n_lines:]
+            if lines:
+                chunks.append(f"--- worker_{worker_id}{ext} (tail) ---")
+                chunks.extend(lines)
+        return "\n".join(chunks)
+
+    def _write_postmortem(self, w: "_Worker", tail: str) -> Optional[str]:
+        """Pairs a dying worker's output tail with the flight dumps:
+        `ray-tpu debug dump` output and the trace merge both sweep the
+        flight dir, so the post-mortem lands where the rings are."""
+        from ..observability import flight_recorder as _fr
+
+        try:
+            d = _fr.flight_dir()
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"postmortem_{w.worker_id}_{time.time_ns() // 1000}.json"
+            )
+            payload = {
+                "worker_id": w.worker_id,
+                "node_id": self.node_id,
+                "actor_id": w.actor_id,
+                "exit_code": w.proc.poll(),
+                "task": (w.busy_with or {}).get("desc"),
+                "tail": tail.splitlines(),
+            }
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, default=repr)
+            os.replace(tmp, path)
+            return path
+        except OSError:
+            return None
 
     # ----------------------------------------------------- worker service
     def worker_poll(self, worker_id: str) -> dict:
@@ -1882,7 +2077,7 @@ class RayletService:
                     break  # died at boot; Popen path serves everyone
                 time.sleep(0.05)
         except Exception as e:  # noqa: BLE001
-            print(f"raylet: zygote boot failed: {e!r}", file=sys.stderr, flush=True)
+            _log.warning("zygote boot failed: %r", e)
             self._zygote = None
         # Prestart (reference: worker_pool.h PrestartWorkers): a warm idle
         # pool so the first task/actor burst never pays worker cold-start.
@@ -1903,7 +2098,7 @@ class RayletService:
                     self._idle.setdefault("", []).append(w.worker_id)
             self._sched_wake.set()  # fresh pool may unblock queued work
         except Exception as e:  # noqa: BLE001
-            print(f"raylet: worker prestart failed: {e!r}", file=sys.stderr, flush=True)
+            _log.warning("worker prestart failed: %r", e)
 
     def _spawn_worker(
         self, actor_id: Optional[str] = None, env_key: str = "", runtime_env=None
@@ -1917,6 +2112,9 @@ class RayletService:
         worker_id = uuid.uuid4().hex[:12]
         env = dict(os.environ)
         env["RAY_TPU_WORKER"] = "1"
+        # Workers write their structured JSONL log next to their captured
+        # stdout/stderr, under this node's session log dir.
+        env["RAY_TPU_LOG_DIR"] = self._log_dir
         desc = json.loads(env_key) if env_key else {}
         if runtime_env:
             desc.setdefault("runtime_env", runtime_env)
@@ -2075,6 +2273,54 @@ class RayletService:
                 except OSError:
                     pass
                 entry = w.busy_with
+                # Crash post-mortem: on an ABNORMAL exit, capture the
+                # dying process's last output lines — appended to the
+                # error surfaced to the owner, written next to the
+                # flight dumps, and reported to the cluster error table.
+                # DELIBERATE kills (kill_actor marks the actor DEAD before
+                # signaling; force-cancel marks the task cancelled) are
+                # normal teardown, not crashes — reporting them would bury
+                # real failures in `ray-tpu status` noise.
+                deliberate = False
+                if w.actor_id is not None:
+                    with self._actor_lock:
+                        a = self._actors.get(w.actor_id)
+                    deliberate = a is not None and a.get("state") == "DEAD"
+                if entry is not None and entry.get("task_id") in self._cancelled:
+                    deliberate = True
+                tail = ""
+                if not deliberate and w.proc.poll() not in (0, None):
+                    tail = self._worker_log_tail(w.worker_id)
+                    self._write_postmortem(w, tail)
+                    if entry is not None or w.actor_id is not None:
+                        _log.warning(
+                            "worker %s died abnormally (exit %s, task=%s)",
+                            w.worker_id,
+                            w.proc.poll(),
+                            (entry or {}).get("desc"),
+                        )
+                        try:
+                            self.gcs.notify(
+                                "report_error",
+                                {
+                                    "type": "worker_crash",
+                                    "node_id": self.node_id,
+                                    "worker_id": w.worker_id,
+                                    "actor_id": w.actor_id,
+                                    "error": (
+                                        f"worker died (exit {w.proc.poll()})"
+                                        + (
+                                            f" executing {entry.get('desc', 'task')}"
+                                            if entry
+                                            else ""
+                                        )
+                                    ),
+                                    "log_tail": tail[-4000:],
+                                },
+                            )
+                        except Exception:
+                            pass
+                tail_note = f"; last output:\n{tail[-2000:]}" if tail else ""
                 if entry is not None:
                     if entry["type"] == "task":
                         self._release_entry(entry)
@@ -2105,10 +2351,11 @@ class RayletService:
                             entry,
                             exc.WorkerCrashedError(
                                 f"worker died executing {entry.get('desc','task')}"
+                                f"{tail_note}"
                             ),
                         )
                 if w.actor_id is not None:
-                    self._on_actor_worker_death(w)
+                    self._on_actor_worker_death(w, tail_note)
             with self._buf_lock:
                 retry, self._deferred_deletes = list(self._deferred_deletes), set()
             if retry:
@@ -2118,7 +2365,7 @@ class RayletService:
             if self.store.bytes_in_use() > CONFIG.spill_threshold * cap:
                 self._spill_to(int(0.75 * CONFIG.spill_threshold * cap))
 
-    def _on_actor_worker_death(self, w: _Worker) -> None:
+    def _on_actor_worker_death(self, w: _Worker, tail_note: str = "") -> None:
         aid = w.actor_id
         with self._actor_lock:
             a = self._actors.get(aid)
@@ -2132,7 +2379,9 @@ class RayletService:
             held, a["resources_held"] = a.get("resources_held", False), False
         # Fail everything dispatched or queued to the dead worker so gets
         # raise instead of hanging (reference: ActorDiedError path).
-        err = RuntimeError(f"actor {aid[:8]} died (worker process exited)")
+        err = RuntimeError(
+            f"actor {aid[:8]} died (worker process exited){tail_note}"
+        )
         for e in inflight:
             self._store_error_for(e, err)
         while True:
@@ -2146,7 +2395,9 @@ class RayletService:
             self._release_entry(creation_entry)
         if was_dead:
             return  # killed deliberately; GCS already informed, no restart
-        decision = self.gcs.call("actor_died", aid, "worker process died", False)
+        decision = self.gcs.call(
+            "actor_died", aid, f"worker process died{tail_note[:1200]}", False
+        )
         if decision.get("restart"):
             node = decision["node"]
             spec_blob = decision["spec_blob"]
@@ -2255,10 +2506,17 @@ def main(argv: List[str]) -> None:
     tcp_spec = argv[8] if len(argv) > 8 and argv[8] else None
 
     from ..observability.flight_recorder import install_crash_hooks
+    from ..observability.logs import configure as _logs_configure
     from ..utils.sampling_profiler import maybe_start_from_env
 
     maybe_start_from_env("raylet")
     install_crash_hooks("raylet")
+    _logs_configure(
+        "raylet",
+        node_id=node_id,
+        directory=os.path.join(os.path.dirname(sock_path) or ".", "logs"),
+    )
+    _log.info("raylet started (node %s, pid %d)", node_id[:12], os.getpid())
 
     # Multi-host mode: pre-bind the TCP endpoint (resolving an ephemeral
     # port) so the service can advertise it at registration; the service
@@ -2278,7 +2536,7 @@ def main(argv: List[str]) -> None:
     )
     if tcp_server is not None:
         tcp_server.service = service
-        print(f"RAYLET_TCP_ADDRESS={tcp_server.address}", flush=True)
+        print(f"RAYLET_TCP_ADDRESS={tcp_server.address}", flush=True)  # console-output: bootstrap protocol read by _read_announced
     server = RpcServer(sock_path, service)
     try:
         while not service._stop.wait(0.5):

@@ -77,12 +77,12 @@ class RpcServer:
         if self._kind == "uds" and os.path.exists(path):
             os.unlink(path)
         if self._kind == "tcp" and not self._auth:
-            print(
-                "ray_tpu: serving the control plane on TCP without "
-                "RAY_TPU_AUTH_TOKEN — anyone who can reach this port can "
-                "execute code as this user; only use on trusted networks.",
-                file=__import__("sys").stderr,
-                flush=True,
+            from ..observability.logs import get_logger
+
+            get_logger("rpc").warning(
+                "serving the control plane on TCP without RAY_TPU_AUTH_TOKEN "
+                "— anyone who can reach this port can execute code as this "
+                "user; only use on trusted networks."
             )
 
         server_self = self

@@ -127,6 +127,30 @@ def main(argv: List[str]) -> None:
 
     install_crash_hooks("worker")
 
+    # Our stdout/stderr fds are the per-worker capture files the raylet
+    # opened at spawn. Line-buffer them: a task's print() must reach the
+    # log monitor (and the driver) when the line completes, not when a
+    # 8 KiB block buffer happens to fill.
+    for _stream in (sys.stdout, sys.stderr):
+        try:
+            _stream.reconfigure(line_buffering=True)
+        except (AttributeError, ValueError, OSError):
+            pass
+
+    from ..observability import logs as _logs
+
+    # Structured records land in worker_<id>.jsonl next to the captured
+    # stdout/stderr; INFO+ records also mirror a human line to stderr so
+    # user `logging` output reaches the driver console like prints do.
+    _logs.configure(
+        "worker",
+        node_id=node_id,
+        worker_id=worker_id,
+        mirror_stderr=True,
+        capture_root=True,
+    )
+    _wlog = _logs.get_logger("worker")
+
     import pickle
     import queue
     import socket as socketlib
@@ -320,9 +344,41 @@ def main(argv: List[str]) -> None:
             )
             sealed.append(rid.hex())
 
+    # Uncaught-exception reports to the GCS error table (reference: the
+    # error pubsub surfacing worker exceptions at the driver / in `ray
+    # list cluster-events`). One-way, bounded per process so a tight
+    # failure loop cannot flood the control plane.
+    error_report_budget = [200]
+
+    def _report_task_error(entry: dict, err: BaseException) -> None:
+        if isinstance(err, (exc.TaskCancelledError, SystemExit)):
+            return
+        if error_report_budget[0] <= 0:
+            return
+        error_report_budget[0] -= 1
+        import traceback as _tb
+
+        try:
+            runtime._gcs.notify(
+                "report_error",
+                {
+                    "type": "task_error",
+                    "node_id": node_id,
+                    "worker_id": worker_id,
+                    "task_id": entry.get("task_id"),
+                    "actor_id": entry.get("actor_id"),
+                    "task": entry.get("desc", ""),
+                    "error": repr(err),
+                    "traceback": _tb.format_exc()[-4000:],
+                },
+            )
+        except Exception:
+            pass
+
     def store_error(entry: dict, err: BaseException, sealed: List[str]) -> None:
         if not isinstance(err, exc.RayTpuError):
             err = exc.TaskError(err, task_desc=entry.get("desc", ""))
+        _report_task_error(entry, err)
         inline = entry.get("_inline")
         if inline is not None:
             try:
@@ -511,7 +567,7 @@ def main(argv: List[str]) -> None:
 
     def _dlog(msg: str) -> None:
         if _dbg:
-            print(f"[direct {worker_id[:6]}] {msg}", file=sys.stderr, flush=True)
+            _wlog.info("[direct %s] %s", worker_id[:6], msg)
 
     # ----- concurrent actor executors -------------------------------------
     pool: Optional[Any] = None  # ThreadPoolExecutor for threaded actors

@@ -218,6 +218,8 @@ class _Dashboard:
                 return gcs.call("internal_metrics")
             if path == "alerts":
                 return gcs.call("active_alerts")
+            if path == "errors":
+                return gcs.call("cluster_errors", 100)
             if path == "jobs":
                 from .jobs import list_job_records
 
@@ -293,6 +295,40 @@ class _Dashboard:
                             "metrics_history", name, tags or None, window_s, as_rate
                         )
                         self._reply(200, json.dumps(series, default=str).encode())
+                    except Exception as e:  # noqa: BLE001
+                        self._reply(500, json.dumps({"error": repr(e)}).encode())
+                    return
+                if self.path.startswith("/api/logs"):
+                    # Structured log query (reference: the dashboard's
+                    # /api/v0/logs state route): ?node=&actor=&task=&
+                    # component=&level=&grep=&tail=N — fans tail_logs out
+                    # to every raylet via observability.logs.
+                    from urllib.parse import parse_qs, urlparse
+
+                    try:
+                        from .observability import logs as obslogs
+
+                        q = parse_qs(urlparse(self.path).query)
+
+                        def one(key):
+                            return (q.get(key) or [None])[0]
+
+                        filters = {
+                            "component": one("component"),
+                            "level": one("level"),
+                            "task_id": one("task"),
+                            "actor_id": one("actor"),
+                            "worker_id": one("worker"),
+                            "grep": one("grep"),
+                        }
+                        filters = {k: v for k, v in filters.items() if v}
+                        records = obslogs.query_cluster(
+                            gcs,
+                            node=one("node"),
+                            tail=int(one("tail") or 1000),
+                            **filters,
+                        )
+                        self._reply(200, json.dumps(records, default=str).encode())
                     except Exception as e:  # noqa: BLE001
                         self._reply(500, json.dumps({"error": repr(e)}).encode())
                     return

@@ -257,6 +257,43 @@ def profile_events(profiles: List[dict], top_n: int = 25) -> List[dict]:
     return events
 
 
+def log_events(records: List[dict]) -> List[dict]:
+    """Structured log records (observability/logs.py) as instants on a
+    per-process "log" track. A record carrying a trace_id lands on the
+    SAME pid track as that request's spans (both key on the emitting
+    process), so `ray-tpu trace` shows metrics, spans, flight events,
+    and log lines on one timeline — the log instant sits visually inside
+    the span that emitted it."""
+    events: List[dict] = []
+    for rec in records:
+        ts = rec.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        msg = str(rec.get("msg", ""))
+        events.append(
+            {
+                "name": f"[{rec.get('level', '?')}] {msg[:80]}",
+                "cat": "log",
+                "ph": "i",
+                "s": "t",
+                "ts": int(ts * 1e6),
+                "pid": rec.get("pid", 0),
+                "tid": "log",
+                "args": {
+                    "msg": msg,
+                    "component": rec.get("component"),
+                    "level": rec.get("level"),
+                    "node_id": rec.get("node_id"),
+                    "worker_id": rec.get("worker_id"),
+                    "task_id": rec.get("task_id"),
+                    "actor_id": rec.get("actor_id"),
+                    "trace_id": rec.get("trace_id"),
+                },
+            }
+        )
+    return events
+
+
 def counter_events(metrics: List[dict], ts_us: int) -> List[dict]:
     """Counter tracks sampled at export time (the internal-metrics table
     holds current aggregates, not history — one sample per series)."""
@@ -310,6 +347,7 @@ def build_trace(
     task_events: Optional[List[dict]] = None,
     metrics: Optional[List[dict]] = None,
     profiles: Optional[List[dict]] = None,
+    log_records: Optional[List[dict]] = None,
 ) -> dict:
     """Assembles the full chrome-trace object. Events are stable-sorted
     by timestamp (metadata first — required by some importers)."""
@@ -321,6 +359,7 @@ def build_trace(
     events += flow_events(spans or [])
     events += flight_events(dumps or [])
     events += profile_events(profiles or [])
+    events += log_events(log_records or [])
     events += list(task_events or [])
     if metrics:
         events += counter_events(metrics, now_us)
@@ -334,6 +373,7 @@ def export(
     trace_directory: Optional[str] = None,
     task_events: Optional[List[dict]] = None,
     metrics: Optional[List[dict]] = None,
+    log_records: Optional[List[dict]] = None,
 ) -> dict:
     """Collects everything reachable from this process and builds (and
     optionally writes) the trace. Returns {"trace": ..., "summary": ...}."""
@@ -349,6 +389,7 @@ def export(
         task_events=task_events,
         metrics=metrics,
         profiles=profiles,
+        log_records=log_records,
     )
     if path:
         with open(path, "w") as f:
@@ -360,6 +401,7 @@ def export(
         "flows": n_flows,
         "flight_dumps": len(dumps),
         "profiles": len(profiles),
+        "log_records": len(log_records or []),
         "task_events": len(task_events or []),
     }
     return {"trace": trace, "summary": summary}

@@ -346,6 +346,20 @@ class Watchdog:
                     self._pending.pop(rule.name, None)
                     continue
             _flight_record("watchdog.alert", (rule.name, event["state"], value))
+            try:
+                from .logs import get_logger
+
+                get_logger("watchdog").warning(
+                    "alert %s %s: %s=%r %s %r",
+                    rule.name,
+                    event["state"],
+                    rule.metric,
+                    value,
+                    rule.op,
+                    rule.threshold,
+                )
+            except Exception:
+                pass
             # Dump BEFORE publishing: the alert event carries its dump
             # path, and in-process subscribers may read the published
             # dict before a post-publish mutation lands.

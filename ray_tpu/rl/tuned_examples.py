@@ -121,7 +121,7 @@ def run_regression(name: str, verbose: bool = False) -> Dict[str, Any]:
                 best = max(best, float(r))
             elapsed = time.monotonic() - t0
             if verbose and iters % 10 == 0:
-                print(
+                print(  # console-output: explicit verbose=True progress
                     f"[{spec.name}] iter={iters} steps={env_steps} "
                     f"return={r} best={best:.1f} t={elapsed:.0f}s",
                     flush=True,

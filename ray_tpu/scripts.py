@@ -262,6 +262,21 @@ def cmd_status(args) -> None:
             )
     else:
         print("alerts: none")
+    # Recent cluster errors (uncaught worker exceptions / crashes fed by
+    # the error-report pubsub): the "what broke" pointer next to the
+    # metrics. Full records via state.cluster_errors() / `ray-tpu logs`.
+    try:
+        errors = state.cluster_errors(50)
+    except Exception:
+        errors = []
+    if errors:
+        print(f"errors: {len(errors)} recent (newest last)")
+        for e in errors[-3:]:
+            who = str(e.get("actor_id") or e.get("task") or e.get("worker_id") or "?")
+            print(
+                f"  [{e.get('type', 'error')}] node={str(e.get('node_id') or '?')[:8]} "
+                f"{who[:40]}: {str(e.get('error', ''))[:120]}"
+            )
 
 
 _CLUSTER_STATE_DIR = os.path.expanduser("~/.ray_tpu/clusters")
@@ -538,10 +553,73 @@ def cmd_jobs(args) -> None:
 
 
 def cmd_logs(args) -> None:
+    """`ray-tpu logs`: query the cluster's structured log stream
+    (per-process JSONL session logs + captured worker stdout/stderr,
+    merged across nodes by the raylet `tail_logs` fan-out). With a
+    positional job id, prints that job's captured output instead."""
     _connect(args)
-    from .jobs import JobSubmissionClient
+    if getattr(args, "job_id", None):
+        from .jobs import JobSubmissionClient
 
-    sys.stdout.write(JobSubmissionClient().get_job_logs(args.job_id))
+        sys.stdout.write(JobSubmissionClient().get_job_logs(args.job_id))
+        return
+    from .observability import logs as obslogs
+    from .utils import state
+
+    actor = args.actor
+    if actor:
+        # Accept an actor NAME as well as an id prefix.
+        try:
+            for a in state.list_actors(100_000):
+                if a.get("name") == actor:
+                    actor = a["actor_id"]
+                    break
+        except Exception:
+            pass
+    filters = {
+        "component": args.component,
+        "level": args.level,
+        "task_id": args.task,
+        "actor_id": actor,
+        "grep": args.grep,
+    }
+    filters = {k: v for k, v in filters.items() if v}
+    since = None
+    # Follow mode re-polls with a 5 s OVERLAP window + client-side dedup
+    # instead of a strict high-water cursor: one node's tail_logs RPC
+    # failing (silently skipped by the fan-out) or lagging the fastest
+    # node's timestamps must not permanently drop its records.
+    seen: dict = {}
+    overlap_s = 5.0
+    try:
+        while True:
+            recs = state.cluster_logs(
+                node=args.node,
+                tail=args.tail if since is None else None,
+                since_ts=(since - overlap_s) if since is not None else None,
+                **filters,
+            )
+            for r in recs:
+                key = (r.get("ts"), r.get("pid"), r.get("node_id"), r.get("msg"))
+                if key in seen:
+                    continue
+                seen[key] = r.get("ts") or 0.0
+                print(obslogs.format_record(r))
+            if not args.follow:
+                return
+            if recs:
+                since = max(
+                    since or 0.0, max(float(r.get("ts") or 0.0) for r in recs)
+                )
+            elif since is None:
+                since = time.time()
+            if since is not None:
+                cutoff = since - 2 * overlap_s
+                for key in [k for k, ts in seen.items() if ts < cutoff]:
+                    del seen[key]
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        return
 
 
 def format_metrics_table(sections) -> str:
@@ -786,14 +864,24 @@ def cmd_trace(args) -> None:
         metrics = state.internal_metrics()
     except Exception:
         metrics = []
+    try:
+        # Log records merge as instants on the emitting process's track;
+        # trace_id-linked lines land inside that request's spans.
+        log_records = state.cluster_logs(tail=20_000)
+    except Exception:
+        log_records = []
     result = perfetto.export(
-        path=args.out, task_events=task_events, metrics=metrics
+        path=args.out,
+        task_events=task_events,
+        metrics=metrics,
+        log_records=log_records,
     )
     s = result["summary"]
     print(
         f"wrote {s['events']} events to {args.out} "
         f"({s['spans']} spans, {s['flows']} flow arrows, "
         f"{s['flight_dumps']} flight dumps, {s.get('profiles', 0)} profiles, "
+        f"{s.get('log_records', 0)} log records, "
         f"{s['task_events']} task rows) — open at ui.perfetto.dev"
     )
     if not s["spans"]:
@@ -964,9 +1052,36 @@ def main(argv=None) -> None:
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_jobs)
 
-    p = sub.add_parser("logs", help="print a job's captured output")
+    p = sub.add_parser(
+        "logs",
+        help="query cluster logs (structured records + captured worker "
+        "output); with a job id, print that job's output",
+    )
     p.add_argument("--address", default=None)
-    p.add_argument("job_id")
+    p.add_argument("job_id", nargs="?", default=None)
+    p.add_argument("--node", default=None, help="node id prefix filter")
+    p.add_argument(
+        "--actor", default=None, help="actor id prefix or actor name"
+    )
+    p.add_argument("--task", default=None, help="task id prefix filter")
+    p.add_argument(
+        "--component",
+        default=None,
+        help="component filter (e.g. raylet, worker, serve, stdout, stderr)",
+    )
+    p.add_argument(
+        "--level", default=None, help="minimum level (DEBUG/INFO/WARNING/ERROR)"
+    )
+    p.add_argument("--grep", default=None, help="substring filter on messages")
+    p.add_argument(
+        "--follow",
+        "-f",
+        action="store_true",
+        help="keep polling for new records (ctrl-c to stop)",
+    )
+    p.add_argument(
+        "--tail", type=int, default=100, help="show only the newest N records"
+    )
     p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser(

@@ -695,6 +695,22 @@ NODE_HEARTBEAT_LAG = Gauge(
     component="gcs",
     tag_keys=("node",),
 )
+# --- logging --------------------------------------------------------------
+LOGS_EVICTED = Counter(
+    "raytpu_logs_evicted_total",
+    "Session log files evicted by the size-capped retention GC",
+    component="raylet",
+)
+LOG_LINES_PUBLISHED = Counter(
+    "raytpu_log_lines_published_total",
+    "Captured worker output lines published on the logs pubsub channel",
+    component="raylet",
+)
+ERROR_REPORTS = Counter(
+    "raytpu_error_reports_total",
+    "Uncaught worker exceptions / crashes reported to the GCS error table",
+    component="gcs",
+)
 
 
 # ========================================================== reporter agent

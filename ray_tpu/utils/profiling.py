@@ -49,7 +49,7 @@ def span(name: str, *, annotate_device: bool = True) -> Iterator[None]:
     with ctx:
         yield
     dt = time.perf_counter() - t0
-    print(f"[span] {name}: {dt * 1e3:.2f} ms", flush=True)
+    print(f"[span] {name}: {dt * 1e3:.2f} ms", flush=True)  # console-output: user-invoked timing utility
 
 
 def save_device_memory_profile(path: str) -> None:

@@ -101,6 +101,30 @@ def active_alerts() -> List[Dict[str, Any]]:
     return _gcs().call("active_alerts")
 
 
+def cluster_errors(limit: int = 100) -> List[Dict[str, Any]]:
+    """Recent cluster error reports (observability/logs.py error path):
+    uncaught task exceptions reported by workers and worker crashes
+    reported by raylets — each with node/worker/task/actor attribution
+    and, for crashes, the dying process's captured-output tail. Also
+    published live on the `error_reports` pubsub channel and shown in
+    `ray-tpu status`."""
+    return _gcs().call("cluster_errors", limit)
+
+
+def cluster_logs(
+    node: Optional[str] = None,
+    tail: Optional[int] = 1000,
+    **filters: Any,
+) -> List[Dict[str, Any]]:
+    """Cluster-wide structured log query: fans the raylet `tail_logs`
+    RPC out to every alive node and merges by timestamp. Filters:
+    component, level (minimum), task_id/actor_id/trace_id/worker_id
+    (prefix match), grep (substring), since_ts."""
+    from ..observability import logs as _logs
+
+    return _logs.query_cluster(_gcs(), node=node, tail=tail, **filters)
+
+
 def get_task(task_id: str) -> Optional[Dict[str, Any]]:
     return _gcs().call("get_task_states", [task_id]).get(task_id)
 

@@ -1,0 +1,532 @@
+"""Structured logging subsystem (observability/logs.py).
+
+Covers: JSONL records + rotation/retention, context injection (task/
+actor/trace ids), the capture chain (worker stdout/stderr -> raylet log
+monitor -> `logs` pubsub -> driver re-print with attribution prefixes +
+dedup), the query paths (`tail_logs` RPC, state.cluster_logs, `ray-tpu
+logs` CLI, dashboard /api/logs), the cluster error table, crash
+postmortems (dying worker's output tail in the surfaced error + flight
+dir), the perfetto log-instant merge, and the no-print lint."""
+
+import glob
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.observability import logs as obslogs
+from ray_tpu.utils import state
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wait_for(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.2)
+    return pred()
+
+
+@pytest.fixture(scope="module")
+def rt_cluster():
+    """ONE shared cluster for the plain e2e tests below (each boot costs
+    ~6 s of tier-1 wall; the env-dependent chaos/tracing e2e boots its
+    own). Defined before the env-dependent test so definition order keeps
+    the shared cluster alive through every user."""
+    rt.shutdown()
+    rt.init(num_cpus=4, num_workers=2)
+    yield rt
+    rt.shutdown()
+
+
+@pytest.fixture
+def log_sandbox(tmp_path):
+    """An isolated log dir for unit tests; restores the module state."""
+    d = str(tmp_path / "logs")
+    obslogs.configure("driver", node_id="testnode", directory=d)
+    yield d
+    obslogs.configure("driver", node_id=None, directory=None)
+
+
+# ------------------------------------------------------------------ units
+def test_structured_record_fields_and_context(log_sandbox):
+    from ray_tpu.core.runtime_context import reset_task_context, set_task_context
+
+    log = obslogs.get_logger("unit")
+    tok = set_task_context("task-abc", "actor-def")
+    try:
+        log.info("plain %s", "message")
+    finally:
+        reset_task_context(tok)
+    recs = obslogs.read_records(log_sandbox)
+    assert recs, "no records written"
+    rec = recs[-1]
+    assert rec["msg"] == "plain message"
+    assert rec["level"] == "INFO"
+    assert rec["component"] == "unit"
+    assert rec["node_id"] == "testnode"
+    assert rec["pid"] == os.getpid()
+    assert rec["task_id"] == "task-abc"
+    assert rec["actor_id"] == "actor-def"
+
+
+def test_trace_id_injection(log_sandbox):
+    from ray_tpu import tracing
+
+    exp = tracing.InMemoryExporter()
+    tracing.enable(exp)
+    try:
+        with tracing.span("request"):
+            obslogs.get_logger("unit").info("inside-span")
+        trace_id = exp.spans[0]["trace_id"]
+    finally:
+        tracing.disable()
+    recs = obslogs.read_records(log_sandbox, grep="inside-span")
+    assert recs and recs[-1]["trace_id"] == trace_id
+
+
+def test_rotation_bounds_file_size(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LOG_ROTATE_BYTES", "2000")
+    d = str(tmp_path / "rot")
+    obslogs.configure("driver", node_id="n", directory=d)
+    try:
+        log = obslogs.get_logger("rot")
+        for i in range(300):
+            log.info("filler line %04d", i)
+        names = sorted(os.listdir(d))
+        assert any(n.endswith(".jsonl.1") for n in names), names
+        for n in names:
+            assert os.path.getsize(os.path.join(d, n)) < 4000
+        # Rotated generations still parse into the query path.
+        assert len(obslogs.read_records(d, grep="filler")) > 10
+    finally:
+        obslogs.configure("driver", directory=None)
+
+
+def test_retention_gc_evicts_oldest(tmp_path):
+    d = str(tmp_path / "gc")
+    os.makedirs(d)
+    now = time.time()
+    for i in range(5):
+        path = os.path.join(d, f"worker_{i}.out")
+        with open(path, "wb") as f:
+            f.write(b"x" * 1000)
+        # Oldest first; all older than the min-age guard.
+        os.utime(path, (now - 600 + i, now - 600 + i))
+    evicted = obslogs.gc_log_dir(d, max_bytes=2500, min_age_s=30.0)
+    assert evicted == 3
+    left = sorted(os.listdir(d))
+    assert left == ["worker_3.out", "worker_4.out"]
+    # Under the cap: nothing more to do.
+    assert obslogs.gc_log_dir(d, max_bytes=2500, min_age_s=30.0) == 0
+
+
+def test_read_records_filters(tmp_path):
+    d = str(tmp_path / "q")
+    os.makedirs(d)
+    recs = [
+        {"ts": 1.0, "level": "INFO", "component": "serve", "msg": "request in",
+         "task_id": "aaa111", "actor_id": None, "node_id": "n1", "pid": 1},
+        {"ts": 2.0, "level": "ERROR", "component": "worker", "msg": "boom",
+         "task_id": "bbb222", "actor_id": "act1", "node_id": "n1", "pid": 2},
+        {"ts": 3.0, "level": "DEBUG", "component": "serve", "msg": "noise",
+         "task_id": None, "actor_id": None, "node_id": "n2", "pid": 3},
+    ]
+    with open(os.path.join(d, "x.jsonl"), "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+        f.write("{corrupt\n")  # tolerated
+    assert [r["msg"] for r in obslogs.read_records(d, component="serve")] == [
+        "request in",
+        "noise",
+    ]
+    assert [r["msg"] for r in obslogs.read_records(d, level="WARNING")] == ["boom"]
+    assert [r["msg"] for r in obslogs.read_records(d, task_id="bbb")] == ["boom"]
+    assert [r["msg"] for r in obslogs.read_records(d, actor_id="act1")] == ["boom"]
+    assert [r["msg"] for r in obslogs.read_records(d, grep="req")] == ["request in"]
+    assert [r["msg"] for r in obslogs.read_records(d, since_ts=1.5)] == [
+        "boom",
+        "noise",
+    ]
+    assert len(obslogs.read_records(d, tail=2)) == 2
+
+
+def test_dedup_printer_contains_burst():
+    out = []
+    p = obslogs.DedupPrinter(print_fn=out.append, window_s=60.0)
+    for _ in range(10_000):
+        p.emit("(A pid=1 node=x)", "same line")
+    assert p.stats["suppressed"] >= 9_999
+    assert p.stats["printed"] == 1
+    # Distinct lines pass through untouched.
+    p.emit("(A pid=1 node=x)", "different line")
+    assert out[-1].endswith("different line")
+
+
+def test_dedup_printer_rate_limit():
+    out = []
+    p = obslogs.DedupPrinter(print_fn=out.append, window_s=0.0, max_lines_per_s=50)
+    for i in range(500):
+        p.emit("(A)", f"unique-{i}")
+    assert p.stats["printed"] <= 50
+    assert p.stats["suppressed"] >= 450
+    assert any("rate limit" in line for line in out)
+
+
+def test_format_record_and_prefix():
+    line = obslogs.format_record(
+        {"ts": 1700000000.5, "level": "INFO", "component": "serve",
+         "node_id": "abcdef123", "pid": 42, "msg": "hi",
+         "task_id": "t123", "trace_id": "tr456"}
+    )
+    assert "serve" in line and "pid=42" in line and "task=t123" in line
+    prefix = obslogs.capture_prefix(
+        {"actor": "Talker", "pid": 9, "node_id": "abcdef123", "worker_id": "w1"}
+    )
+    assert prefix == "(Talker pid=9 node=abcdef12)"
+
+
+def test_perfetto_log_instants():
+    from ray_tpu.observability import perfetto
+
+    recs = [
+        {"ts": 10.0, "level": "INFO", "component": "serve", "msg": "hello",
+         "pid": 77, "trace_id": "tr1", "node_id": "n1"},
+        {"ts": None, "msg": "no-ts dropped"},
+    ]
+    events = perfetto.log_events(recs)
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["ph"] == "i" and ev["pid"] == 77 and ev["tid"] == "log"
+    assert ev["args"]["trace_id"] == "tr1"
+    trace = perfetto.build_trace(log_records=recs)
+    assert any(e.get("cat") == "log" for e in trace["traceEvents"])
+
+
+def test_no_print_lint_passes_and_detects():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "check_no_print.py")],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # The detector itself must flag a bare print and honor the marker.
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_no_print", os.path.join(REPO_ROOT, "tools", "check_no_print.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod._line_flagged("    print('hi')\n", "")
+    assert not mod._line_flagged("    print('hi')  # console-output: x\n", "")
+    assert not mod._line_flagged("    pprint(x)\n", "")
+
+
+# ------------------------------------------------------------------- e2e
+def test_driver_capture_and_query_e2e(rt_cluster):
+    """Acceptance: an actor's print() AND logging output reach the driver
+    with `(ActorName pid=... node=...)` prefixes, and the logging record
+    is queryable by actor with task id attached."""
+
+    @rt.remote(name="Chatty")
+    class Chatty:
+        def speak(self):
+            print("e2e-print-line")
+            logging.getLogger("userapp").info("e2e-logging-line")
+            sys.stderr.write("e2e-stderr-line\n")
+            return os.getpid()
+
+    a = Chatty.remote()
+    worker_pid = rt.get(a.speak.remote(), timeout=60)
+
+    from ray_tpu.core import runtime_base
+
+    runtime = runtime_base.current_runtime()
+    assert _wait_for(
+        lambda: sum(
+            1
+            for line in runtime._log_recent
+            if line.startswith(f"(Chatty pid={worker_pid} node=")
+        ) >= 3
+    ), f"captured lines missing at driver: {runtime._log_recent}"
+    joined = "\n".join(runtime._log_recent)
+    for needle in ("e2e-print-line", "e2e-logging-line", "e2e-stderr-line"):
+        assert needle in joined
+
+    # The structured record carries actor + task ids; the raw print got
+    # actor attribution from the capture path.
+    actor_id = a._actor_id.hex()
+    assert _wait_for(
+        lambda: any(
+            r.get("task_id")
+            for r in state.cluster_logs(actor_id=actor_id, grep="e2e-logging-line")
+        )
+    )
+    assert _wait_for(
+        lambda: state.cluster_logs(
+            actor_id=actor_id, component="stdout", grep="e2e-print-line"
+        )
+    )
+
+
+def test_tail_logs_rpc_filters(rt_cluster):
+    @rt.remote
+    def noisy():
+        log = logging.getLogger("filterapp")
+        log.info("keep-this-info")
+        log.error("keep-this-error")
+        return 1
+
+    assert rt.get(noisy.remote(), timeout=60) == 1
+    from ray_tpu.core.rpc import RpcClient
+
+    nodes = [n for n in state.list_nodes() if n.get("Alive")]
+
+    def tails(filters):
+        out = []
+        for n in nodes:
+            out += RpcClient(n["sock"]).call("tail_logs", filters)
+        return out
+
+    assert _wait_for(lambda: tails({"grep": "keep-this-error"}))
+    recs = tails({"component": "filterapp", "level": "ERROR"})
+    assert recs and all(r["level"] == "ERROR" for r in recs)
+    assert any("keep-this-error" in r["msg"] for r in recs)
+    # Unknown filter keys are dropped, not fatal.
+    assert isinstance(tails({"bogus": "x", "grep": "keep-this-info"}), list)
+
+
+def test_cluster_errors_e2e(rt_cluster):
+    """Uncaught worker exception -> error-report pubsub -> GCS table ->
+    state.cluster_errors()."""
+
+    @rt.remote
+    def blows_up():
+        raise ValueError("unique-error-sentinel-77")
+
+    ref = blows_up.remote()
+    with pytest.raises(Exception, match="unique-error-sentinel-77"):
+        rt.get(ref, timeout=60)
+    assert _wait_for(
+        lambda: any(
+            e.get("type") == "task_error"
+            and "unique-error-sentinel-77" in str(e.get("error", ""))
+            and e.get("task_id")
+            for e in state.cluster_errors()
+        )
+    ), state.cluster_errors()
+
+
+def test_logs_cli_and_dashboard_route(rt_cluster):
+    @rt.remote(name="CliActor")
+    class CliActor:
+        def say(self):
+            logging.getLogger("cliapp").warning("cli-sentinel-line")
+            return 1
+
+    a = CliActor.remote()
+    rt.get(a.say.remote(), timeout=60)
+    assert _wait_for(lambda: state.cluster_logs(grep="cli-sentinel-line"))
+
+    # CLI: `ray-tpu logs --grep ... --level WARNING` against this session.
+    from ray_tpu import scripts
+    from ray_tpu.core import runtime_base
+
+    session = runtime_base.current_runtime()._session_dir
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        scripts.main(
+            [
+                "logs",
+                "--address",
+                session,
+                "--grep",
+                "cli-sentinel-line",
+                "--level",
+                "WARNING",
+                "--tail",
+                "10",
+            ]
+        )
+    out = buf.getvalue()
+    assert "cli-sentinel-line" in out and "WARNING" in out
+
+    # CLI actor filter by NAME resolves to the actor id.
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        scripts.main(
+            ["logs", "--address", session, "--actor", "CliActor", "--tail", "50"]
+        )
+    assert "cli-sentinel-line" in buf.getvalue()
+
+    # Dashboard: /api/logs with filters, /api/errors exists.
+    from urllib.request import urlopen
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    port = start_dashboard(port=0)
+    try:
+        with urlopen(
+            f"http://127.0.0.1:{port}/api/logs?grep=cli-sentinel-line&level=WARNING",
+            timeout=30,
+        ) as resp:
+            records = json.loads(resp.read())
+        assert records and any("cli-sentinel-line" in r["msg"] for r in records)
+        with urlopen(f"http://127.0.0.1:{port}/api/errors", timeout=30) as resp:
+            assert isinstance(json.loads(resp.read()), list)
+    finally:
+        stop_dashboard()
+
+
+def test_log_dir_layout_and_worker_jsonl(rt_cluster):
+    """Session log dir holds per-process JSONL next to the captured
+    worker stdout/stderr, and state.log_dir() points at it."""
+
+    @rt.remote
+    def touch():
+        obslogs.get_logger("layout").info("layout-sentinel")
+        return 1
+
+    rt.get(touch.remote(), timeout=60)
+    d = state.log_dir()
+    assert d and os.path.isdir(d)
+
+    def has_layout():
+        names = os.listdir(d)
+        return (
+            any(n.startswith("worker_") and n.endswith(".jsonl") for n in names)
+            and any(n.startswith("raylet_") and n.endswith(".jsonl") for n in names)
+            and any(n.startswith("gcs") and n.endswith(".jsonl") for n in names)
+        )
+
+    assert _wait_for(has_layout), sorted(os.listdir(d))
+    assert _wait_for(
+        lambda: obslogs.read_records(d, grep="layout-sentinel")
+    )
+
+
+# Defined LAST: boots its own cluster (env knobs must precede init),
+# which tears down the module-scoped shared cluster above.
+def test_trace_link_and_chaos_crash_tail(monkeypatch, tmp_path):
+    """Two acceptance e2es on one (env-armed) cluster boot:
+
+    (1) a trace_id-carrying log line appears as an instant on that
+        request's (process) track in the `ray-tpu trace` merge;
+    (2) a chaos-SIGKILLed actor worker's captured-output tail lands in
+        the actor-death reason, the cluster error table, and a
+        postmortem file next to the flight dumps."""
+    trace_dir = str(tmp_path / "traces")
+    monkeypatch.setenv("RAY_TPU_TRACING", "1")
+    monkeypatch.setenv("RAY_TPU_TRACE_DIR", trace_dir)
+    monkeypatch.setenv(
+        "RAY_TPU_CHAOS",
+        json.dumps([{"point": "task.exec", "action": "kill", "match": "task die"}]),
+    )
+    rt.shutdown()
+    rt.init(num_cpus=4, num_workers=2)
+    try:
+        from ray_tpu import tracing
+
+        tracing.enable()
+
+        # --- (1) trace-linked log record --------------------------------
+        @rt.remote
+        def traced_task():
+            logging.getLogger("traceapp").info("traced-log-line")
+            return os.getpid()
+
+        worker_pid = rt.get(traced_task.remote(), timeout=60)
+
+        def get_rec():
+            # component filter: the raylet's capture mirror of the same
+            # line (component stderr) carries no trace id by design.
+            recs = state.cluster_logs(component="traceapp", grep="traced-log-line")
+            return recs[-1] if recs else None
+
+        assert _wait_for(lambda: get_rec() is not None)
+        rec = get_rec()
+        assert rec["trace_id"], rec
+        assert rec["task_id"], rec
+        assert rec["pid"] == worker_pid
+
+        from ray_tpu.observability import perfetto
+
+        spans = tracing.collect(trace_dir)
+        run_spans = [s for s in spans if s.get("trace_id") == rec["trace_id"]]
+        assert run_spans, "no spans for the log record's trace id"
+        trace = perfetto.build_trace(spans=spans, log_records=[rec])
+        instants = [
+            e
+            for e in trace["traceEvents"]
+            if e.get("cat") == "log"
+            and e.get("args", {}).get("trace_id") == rec["trace_id"]
+        ]
+        assert instants, "log instant missing from the merge"
+        # Same track as the request's execution span: the pid the worker
+        # span ran in IS the pid the instant lands on.
+        assert any(
+            s.get("pid") == instants[0]["pid"] for s in run_spans
+        ), (instants[0], run_spans[:3])
+        tracing.disable()
+
+        # --- (2) chaos-killed worker's tail -----------------------------
+        @rt.remote
+        class Doomed:
+            def speak(self):
+                print("chaos-last-words-zzz", flush=True)
+                return 1
+
+            def die(self):
+                return 2  # chaos kills the worker before this runs
+
+        a = Doomed.remote()
+        assert rt.get(a.speak.remote(), timeout=60) == 1
+        with pytest.raises(Exception):
+            rt.get(a.die.remote(), timeout=60)
+        # The actor-death record carries the dying worker's output tail
+        # (the fastpath EOF may surface the raw death first; the GCS
+        # reason is the durable postmortem-bearing message).
+        assert _wait_for(
+            lambda: any(
+                "chaos-last-words-zzz" in str(rec2.get("death_reason", ""))
+                for rec2 in state.list_actors()
+            )
+        ), [rec2.get("death_reason") for rec2 in state.list_actors()]
+        assert _wait_for(
+            lambda: any(
+                e.get("type") == "worker_crash"
+                and "chaos-last-words-zzz" in str(e.get("log_tail", ""))
+                for e in state.cluster_errors()
+            )
+        ), state.cluster_errors()
+        from ray_tpu.observability import flight_recorder
+
+        def postmortem_has_tail():
+            for path in glob.glob(
+                os.path.join(flight_recorder.flight_dir(), "postmortem_*.json")
+            ):
+                try:
+                    with open(path) as f:
+                        payload = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                if any("chaos-last-words-zzz" in ln for ln in payload.get("tail", [])):
+                    return True
+            return False
+
+        assert _wait_for(postmortem_has_tail)
+    finally:
+        rt.shutdown()

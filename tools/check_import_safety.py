@@ -57,6 +57,7 @@ REQUIRED = {
     # an import-time backend init here would wedge the whole cluster.
     "ray_tpu.observability",
     "ray_tpu.observability.flight_recorder",
+    "ray_tpu.observability.logs",
     "ray_tpu.observability.perfetto",
     "ray_tpu.observability.history",
     "ray_tpu.observability.watchdog",
