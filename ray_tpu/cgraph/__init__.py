@@ -40,6 +40,7 @@ from .communicator import (  # noqa: F401
     p2p,
     reduce_scatter,
 )
+from .elastic import ElasticGraph, GangTooSmallError  # noqa: F401
 from .plan import GraphPlan, build_plan  # noqa: F401
 
 __all__ = [
@@ -51,6 +52,8 @@ __all__ = [
     "allreduce",
     "reduce_scatter",
     "p2p",
+    "ElasticGraph",
+    "GangTooSmallError",
     "GraphPlan",
     "build_plan",
 ]

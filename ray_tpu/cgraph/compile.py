@@ -384,7 +384,12 @@ class CompiledGraph:
             except Exception:
                 pass  # actor may already be dead
         for comm in self._comms:
-            comm.destroy()
+            try:
+                comm.destroy()
+            except Exception:
+                # A comm whose gang lost a member must not abort teardown
+                # mid-way (writers/readers below still need closing).
+                pass
         for _, w in self._in_writers:
             w.close()
         for _, r in self._out_readers:

@@ -546,10 +546,15 @@ def destroy_collective_group_on(actors, group_name: str = "default") -> None:
     membership inside every member actor and deregisters their ranks."""
     from . import api
 
-    refs = [
-        a._invoke("__ray_tpu_collective_destroy__", (group_name,), {}, 1)
-        for a in actors
-    ]
+    refs = []
+    for a in actors:
+        try:
+            refs.append(a._invoke("__ray_tpu_collective_destroy__", (group_name,), {}, 1))
+        except Exception:
+            # A DEAD member raises at SUBMIT time (fastpath channel knows
+            # the incarnation is gone before any get) — its membership
+            # state died with the worker; skip it, destroy the rest.
+            pass
     try:
         api.get(refs, timeout=60)
     except Exception:

@@ -69,9 +69,17 @@ class DirectConn:
         on_dead: Callable[[List[dict]], None],
         connect_timeout: float = 15.0,
         on_sealed: Optional[Callable[[List[str]], None]] = None,
+        lessor=None,
+        lease_token: Optional[str] = None,
     ):
         self.worker_id = worker_id
         self.sock_path = sock_path
+        # The raylet that granted this lease + the grant token: an owner
+        # close tells the lessor directly (token-guarded) instead of
+        # relying on the worker observing EOF — a wedged worker must not
+        # pin the node's CPUs forever.
+        self.lessor = lessor
+        self.lease_token = lease_token
         self._sock = _connect_uds(sock_path, connect_timeout)
         self._wlock = threading.Lock()
         self._iflock = threading.Lock()
@@ -113,14 +121,25 @@ class DirectConn:
             return len(self.inflight)
 
     def close(self) -> None:
-        """Owner-initiated close (shutdown): the worker sees EOF and
-        returns its lease; nothing outstanding is failed."""
+        """Owner-initiated close (janitor/shutdown): the worker sees EOF
+        and returns its lease; nothing outstanding is failed. The lessor
+        is ALSO told directly (token-guarded one-way) — EOF delivery has
+        been observed to race multi-conn direct servers, and a lease whose
+        return is lost pins the node's CPUs until the next placement
+        starves (the elastic grow-back failure mode)."""
         with self._dead_lock:
             self.alive = False
         try:
             self._sock.close()
         except OSError:
             pass
+        if self.lessor is not None and self.lease_token is not None:
+            try:
+                self.lessor.notify(
+                    "return_worker_lease", self.worker_id, self.lease_token
+                )
+            except Exception:
+                pass  # raylet gone; its successor holds no such lease
 
     def _reader(self) -> None:
         while True:
@@ -382,6 +401,8 @@ class FastPath:
                 granted["worker_id"],
                 self._on_lease_dead,
                 on_sealed=self._rt._fast_sealed,
+                lessor=raylet,
+                lease_token=granted.get("token"),
             )
         spill = resp.get("spill")
         if spill and hop < 2:

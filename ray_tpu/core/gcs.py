@@ -292,6 +292,13 @@ class GcsService:
                 target=lambda: [self._reschedule_gang(p) for p in retry_gangs],
                 daemon=True,
             ).start()
+        # Capacity-wait subscribers (JaxTrainer's elastic renegotiation)
+        # block on node_events instead of polling the node table: a join
+        # is as much a lifecycle event as a drain.
+        self.pubsub_publish(
+            "node_events",
+            {"event": "node_added", "node_id": node_id, "ts": time.time()},
+        )
         return {"ok": True, "nodes": n_alive}
 
     def heartbeat(self, node_id: str, available: dict, stats: Optional[dict] = None) -> dict:

@@ -1333,23 +1333,37 @@ class RayletService:
         if w is None:
             self._release(resources)
             return {"retry": True}
+        token = uuid.uuid4().hex
         self._leases[w.worker_id] = {
             "resources": resources,
             "granted_at": time.monotonic(),
+            "token": token,
         }
-        w.mailbox.put({"type": "direct"})
+        # The worker echoes the token on ITS return too, so a return from
+        # a previous lease epoch can never pop a fresh re-grant.
+        w.mailbox.put({"type": "direct", "token": token})
         return {
             "granted": {
                 "worker_id": w.worker_id,
                 "sock": self._direct_sock(w.worker_id),
+                "token": token,
             }
         }
 
-    def return_worker_lease(self, worker_id: str) -> bool:
-        """Lease handed back (worker-initiated, after the owner's direct
-        socket closed): release the held resources and pool the worker."""
-        lease = self._leases.pop(worker_id, None)
-        if lease is not None:
+    def return_worker_lease(self, worker_id: str, token: Optional[str] = None) -> bool:
+        """Lease handed back: release the held resources (token-matched)
+        and pool the worker. Both sides of a lease return carry the grant
+        token — the owner (fastpath janitor close) and the worker (direct
+        mode exit) — and both may fire for the same lease, so the pop is
+        token-guarded: a return from a previous lease epoch pools the
+        worker but cannot clobber a lease the raylet already re-granted
+        to a different owner. A tokenless return (the worker's lost-
+        control-message belt re-entry, which never saw a grant) releases
+        nothing; a lease whose every return was lost is reclaimed by the
+        worker_poll sweep instead."""
+        lease = self._leases.get(worker_id)
+        if lease is not None and token is not None and lease.get("token") == token:
+            self._leases.pop(worker_id, None)
             self._release(lease["resources"])
         if os.environ.get("RAY_TPU_DEBUG_DIRECT") == "1":
             _log.info("lease returned by %s", worker_id[:6])
@@ -1726,9 +1740,26 @@ class RayletService:
             # re-deliver instead of wedging the task forever.
             return {"type": "task", "entry": w.busy_with}
         try:
-            return w.mailbox.get(timeout=POLL_TIMEOUT_S)
+            msg = w.mailbox.get(timeout=POLL_TIMEOUT_S)
         except queue.Empty:
-            return {"type": "noop"}
+            msg = {"type": "noop"}
+        if msg.get("type") != "direct" and worker_id in self._leases:
+            # A leased worker never polls for pool work while serving its
+            # lease — so a non-"direct" poll from a lease holder means the
+            # worker already left direct mode and its return_worker_lease
+            # notification was lost (observed under owner-janitor close
+            # races). Without this reclaim the held CPUs leak FOREVER,
+            # starving later placement groups / gang re-forms. The grace
+            # window covers the grant→"direct"-delivery hop (the worker
+            # may poll "noop" between the lease being recorded and the
+            # mailbox message reaching it).
+            lease = self._leases.get(worker_id)
+            if (
+                lease is not None
+                and time.monotonic() - lease.get("granted_at", 0.0) > 2.0
+            ):
+                self.return_worker_lease(worker_id, lease.get("token"))
+        return msg
 
     def worker_step(self, worker_id: str, done: Optional[dict] = None) -> dict:
         """Combined completion report + next-task poll: the serial worker

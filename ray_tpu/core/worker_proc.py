@@ -853,11 +853,14 @@ def main(argv: List[str]) -> None:
 
     threading.Thread(target=_serve_direct, daemon=True, name="direct-srv").start()
 
-    def _run_direct_mode() -> None:
+    def _run_direct_mode(lease_token=None) -> None:
         """Lease mode: drain direct-pushed tasks on the main thread until
         the lease owner disconnects, then hand the worker back to the
         raylet pool (reference: the leased worker returning to the raylet
-        after lease_expiration, normal_task_submitter.cc ReturnWorker)."""
+        after lease_expiration, normal_task_submitter.cc ReturnWorker).
+        `lease_token` is echoed on the return so the raylet can tell THIS
+        lease epoch's return from a stale one (None on the lost-control-
+        message belt re-entry, which releases nothing)."""
         entered = time.monotonic()
         epoch_accepts = accept_count[0]
         last_lease_check = time.monotonic()
@@ -956,7 +959,7 @@ def main(argv: List[str]) -> None:
                 executing_main.clear()
                 send_done(entry["task_id"], True, sealed, entry.get("_inline"))
                 fp_report(sealed, (entry["task_id"], "FINISHED"))
-                raylet.notify("return_worker_lease", worker_id)
+                raylet.notify("return_worker_lease", worker_id, lease_token)
                 os._exit(0)
             finally:
                 executing_main.clear()
@@ -980,8 +983,9 @@ def main(argv: List[str]) -> None:
             # Leased to an owner for direct pushes (the inbox check is the
             # belt for a lost control message: direct frames queued while
             # we idled in worker_step still get served).
-            _run_direct_mode()
-            raylet.notify("return_worker_lease", worker_id)
+            token = msg.get("token")
+            _run_direct_mode(token)
+            raylet.notify("return_worker_lease", worker_id, token)
             continue
         if kind == "noop":
             continue

@@ -130,6 +130,25 @@ class PreemptionError(RayTpuError):
         super().__init__(f"gang preempted (node(s) {nodes} draining): {reason}")
 
 
+class CapacityTimeoutError(RayTpuError, TimeoutError):
+    """The capacity wait after a preemption expired and no feasible gang
+    exists (non-elastic run, or feasible world below min_workers). Raised
+    INSTEAD of launching a doomed attempt that would burn a retry against
+    an empty cluster."""
+
+    def __init__(self, needed: int, feasible: int, waited_s: float, min_workers: int = 0):
+        self.needed = needed
+        self.feasible = feasible
+        self.waited_s = waited_s
+        self.min_workers = min_workers
+        super().__init__(
+            f"no capacity for a {needed}-worker gang after {waited_s:.0f}s "
+            f"(largest feasible world: {feasible}"
+            + (f", elastic floor {min_workers}" if min_workers else "")
+            + ")"
+        )
+
+
 class TaskCancelledError(RayTpuError):
     pass
 

@@ -423,6 +423,66 @@ def next_token_loss(
     return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
 
 
+def build_train_step(
+    cfg: TransformerConfig,
+    tx,
+    mesh: Mesh,
+    *,
+    zero_axis: Optional[str] = None,
+    donate: bool = True,
+):
+    """The standard data-parallel train step (fwd+bwd+optimizer), with the
+    optimizer update optionally ZeRO-sharded over `zero_axis`
+    (train/zero.py: reduce_scatter grads -> shard-local update ->
+    all_gather params; per-chip optimizer state ~1/N — the headroom the
+    7B-on-v5e-64 envelope needs, AOT_7B_r05).
+
+    Returns `(init_state, step)`:
+      init_state(rng) -> (params, opt_state)  [opt_state sharded when zero]
+      step(params, opt_state, tokens) -> (params, opt_state, loss)
+    `tokens` is the global [batch, seq] int array, batch-sharded over
+    `zero_axis` in the ZeRO path.
+    """
+    import optax
+
+    if zero_axis is None:
+
+        def init_state(rng):
+            params = init_params(rng, cfg)
+            return params, tx.init(params)
+
+        def train_step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(next_token_loss)(
+                params, tokens, cfg, mesh
+            )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return init_state, jax.jit(
+            train_step, donate_argnums=(0, 1) if donate else ()
+        )
+
+    from ..train import zero as _zero
+
+    abstract = jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg))
+    # Inside the shard_map block the step sees its LOCAL batch shard and a
+    # replicated param copy; attention and loss run mesh-free per shard.
+    step, _sharder = _zero.build_zero_step(
+        lambda p, tokens: next_token_loss(p, tokens, cfg, None),
+        tx,
+        abstract,
+        mesh,
+        axis=zero_axis,
+        donate=donate,
+    )
+
+    def init_state(rng):
+        params = init_params(rng, cfg)
+        return params, _zero.init_opt_state(tx, params, mesh, zero_axis)
+
+    return init_state, step
+
+
 def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
     """Approximate training FLOPs/token (6N + attention) for MFU accounting.
 

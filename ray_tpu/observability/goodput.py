@@ -34,8 +34,13 @@ PRODUCTIVE = "productive"
 CHECKPOINT = "checkpoint"
 DRAIN_WAIT = "drain_wait"
 RESTART_REWORK = "restart_rework"
+# Elastic runs: steps ARE advancing but the gang is below its target
+# world size (capacity never came back inside the wait budget and the
+# trainer re-formed smaller). Weighted into goodput by world/target —
+# half the chips productive is half the goodput, not zero and not full.
+DEGRADED = "degraded"
 
-CATEGORIES = (SETUP, PRODUCTIVE, CHECKPOINT, DRAIN_WAIT, RESTART_REWORK)
+CATEGORIES = (SETUP, PRODUCTIVE, CHECKPOINT, DRAIN_WAIT, RESTART_REWORK, DEGRADED)
 
 # Peak bf16 FLOP/s per chip by generation (public spec sheets; mirrors
 # bench.py's table so the bench and the runtime agree on MFU).
@@ -58,6 +63,15 @@ class GoodputAccountant:
         self._category: Optional[str] = None
         self._since: float = 0.0
         self.seconds: Dict[str, float] = {c: 0.0 for c in CATEGORIES}
+        # Category -> goodput weight. PRODUCTIVE counts 1.0; DEGRADED is
+        # set by the supervisor to world/target when it downsizes.
+        self._weights: Dict[str, float] = {PRODUCTIVE: 1.0}
+
+    def set_weight(self, category: str, weight: float) -> None:
+        """Credit `category` seconds at `weight` (0..1) in fraction()."""
+        if category not in self.seconds:
+            raise ValueError(f"unknown goodput category {category!r}")
+        self._weights[category] = max(0.0, min(1.0, float(weight)))
 
     @property
     def category(self) -> Optional[str]:
@@ -84,14 +98,19 @@ class GoodputAccountant:
         return sum(self.seconds.values()) + extra
 
     def fraction(self) -> float:
-        """productive / total; 1.0 for a run too short to have history
-        (an empty ledger must not trip the goodput_floor watchdog)."""
+        """Weighted productive time / total (PRODUCTIVE at 1.0, DEGRADED
+        at its world/target weight); 1.0 for a run too short to have
+        history (an empty ledger must not trip the goodput_floor
+        watchdog)."""
         total = self.total()
         if total <= 0:
             return 1.0
-        productive = self.seconds[PRODUCTIVE]
-        if self._category == PRODUCTIVE:
-            productive += self._clock() - self._since
+        seconds = dict(self.seconds)
+        if self._category is not None:
+            seconds[self._category] += self._clock() - self._since
+        productive = sum(
+            seconds[c] * w for c, w in self._weights.items() if w > 0
+        )
         return productive / total
 
     def snapshot(self) -> Dict[str, object]:

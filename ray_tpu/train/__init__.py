@@ -5,6 +5,7 @@ JaxTrainer replaces TorchTrainer; mesh construction replaces NCCL process
 groups; in-program psum replaces DDP allreduce.
 """
 
+from . import elastic_checkpoint, zero
 from .checkpoint import Checkpoint, CheckpointManager, StorageContext, load_pytree, save_pytree
 from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
 from .session import (
@@ -34,4 +35,5 @@ __all__ = [
     "ScalingConfig", "configure_telemetry", "drain_requested",
     "get_checkpoint", "get_context", "get_session", "phase", "report",
     "JaxTrainer", "Result", "WorkerGroup", "get_mesh",
+    "elastic_checkpoint", "zero",
 ]

@@ -31,10 +31,25 @@ class ScalingConfig:
     # Multi-host runtime rendezvous; None with num_workers>1 uses defaults
     # (loopback coordinator — the emulated-cluster / single-machine case).
     backend: Optional[Any] = None  # JaxBackendConfig
+    # Elastic world size: when capacity does not return within the wait
+    # budget after a preemption, an elastic trainer re-forms the gang at
+    # the largest feasible world >= min_workers and resumes same-step
+    # from the (world-size-independent) checkpoint, then grows back to
+    # num_workers at a checkpoint boundary once capacity returns.
+    elastic: bool = False
+    min_workers: Optional[int] = None  # elastic floor; None -> 1
+    # Seconds fit() waits for replacement capacity after a preemption
+    # before downsizing (elastic) or failing fast (CapacityTimeoutError);
+    # None -> trainer.CAPACITY_WAIT_S.
+    capacity_wait_s: Optional[float] = None
 
     @property
     def total_workers(self) -> int:
         return max(1, self.num_workers)
+
+    @property
+    def elastic_floor(self) -> int:
+        return max(1, self.min_workers if self.min_workers is not None else 1)
 
 
 @dataclasses.dataclass

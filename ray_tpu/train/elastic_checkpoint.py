@@ -1,0 +1,302 @@
+"""World-size-independent ("elastic") checkpoint format.
+
+The preemption story of PR 7 could only restore onto a replacement slice
+of the SAME world size: `save_pytree` writes whatever sharding the run
+happened to have, so a gang of N hosts could not hand its state to a gang
+of M. This module is the resharding half of elastic training (ROADMAP
+item 5; arXiv:2004.13336's cross-replica sharding assumes exactly this):
+every leaf of a pytree is stored as a world-size-independent GLOBAL
+logical array, split into per-rank files along a deterministic flat
+partition, plus a JSON manifest describing the global shapes. A
+checkpoint written at world N restores at world M with a pure index
+computation — no all-gather, no torch-style "consolidate then reshard"
+step, and a rank only reads the bytes that overlap its new slice.
+
+Layout (one directory, several kinds may share it):
+
+    <dir>/<kind>_manifest.json            # format/step/world_size/leaves
+    <dir>/<kind>_treedef.pkl              # exact pytree structure
+    <dir>/<kind>_shard_00002of00004.npz   # rank 2 of 4's slice per leaf
+
+Partition rule: leaf flattened to 1-D of length L; rank r of N owns
+[L*r//N, L*(r+1)//N) — contiguous, exhaustive, no padding, stable under
+integer arithmetic, so save@N -> restore@M -> save@M -> restore@N is
+bitwise-exact (tests/test_elastic.py proves it for N,M in {1,2,4}).
+
+Raw bytes are stored as uint8 views with the dtype name in the manifest:
+bfloat16 and friends round-trip without depending on numpy knowing how
+to serialize ml_dtypes scalars.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+FORMAT = "raytpu-elastic-v1"
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bundled with jax
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _as_numpy(leaf: Any) -> np.ndarray:
+    """Host numpy view of a (possibly device/global) array leaf."""
+    try:
+        import jax
+
+        leaf = jax.device_get(leaf)
+    except Exception:
+        pass
+    return np.asarray(leaf)
+
+
+def shard_bounds(n_elems: int, world_size: int, rank: int) -> Tuple[int, int]:
+    """Rank `rank` of `world_size`'s [start, stop) slice of a flat leaf."""
+    if world_size < 1 or not (0 <= rank < world_size):
+        raise ValueError(f"bad shard coords rank={rank} world={world_size}")
+    return (n_elems * rank) // world_size, (n_elems * (rank + 1)) // world_size
+
+
+def _shard_file(kind: str, rank: int, world_size: int) -> str:
+    return f"{kind}_shard_{rank:05d}of{world_size:05d}.npz"
+
+
+def _observe(op: str, t0: float) -> None:
+    from ..utils import internal_metrics as imet
+
+    imet.TRAIN_RESHARD_TIME.observe((time.perf_counter() - t0) * 1e3, op=op)
+
+
+def save_shards(
+    directory: str,
+    tree: Any,
+    *,
+    kind: str = "params",
+    world_size: int = 1,
+    rank: int = 0,
+    step: int = 0,
+    meta: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Writes `rank`'s shard of `tree` (+ manifest/treedef once, by rank 0).
+
+    Every rank holds the full logical tree (replicated params) or at least
+    its own slice of it — pass the full tree; only the rank's [start,stop)
+    bytes of each leaf are written. All files land via tmp+rename so a
+    preemption mid-save cannot leave a torn checkpoint.
+    """
+    import jax
+
+    t0 = time.perf_counter()
+    os.makedirs(directory, exist_ok=True)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrays: Dict[str, np.ndarray] = {}
+    entries: List[Dict[str, Any]] = []
+    for i, leaf in enumerate(leaves):
+        arr = _as_numpy(leaf)
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        start, stop = shard_bounds(flat.size, world_size, rank)
+        # uint8 view: bitwise bytes on disk, dtype recorded in the manifest.
+        arrays[str(i)] = flat[start:stop].view(np.uint8) if flat.size else flat.view(np.uint8)
+        entries.append(
+            {"shape": list(arr.shape), "dtype": str(arr.dtype), "size": int(flat.size)}
+        )
+    shard_path = os.path.join(directory, _shard_file(kind, rank, world_size))
+    with open(shard_path + ".tmp", "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(shard_path + ".tmp", shard_path)
+    if rank == 0:
+        manifest = {
+            "format": FORMAT,
+            "kind": kind,
+            "step": int(step),
+            "world_size": int(world_size),
+            "leaves": entries,
+            "meta": dict(meta or {}),
+        }
+        mpath = os.path.join(directory, f"{kind}_manifest.json")
+        with open(mpath + ".tmp", "w") as f:
+            json.dump(manifest, f, indent=1)
+        os.replace(mpath + ".tmp", mpath)
+        tpath = os.path.join(directory, f"{kind}_treedef.pkl")
+        with open(tpath + ".tmp", "wb") as f:
+            pickle.dump(treedef, f)
+        os.replace(tpath + ".tmp", tpath)
+    _observe("save", t0)
+
+
+def read_manifest(directory: str, kind: str = "params") -> Dict[str, Any]:
+    with open(os.path.join(directory, f"{kind}_manifest.json")) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != FORMAT:
+        raise ValueError(
+            f"{directory}: unknown elastic checkpoint format {manifest.get('format')!r}"
+        )
+    return manifest
+
+
+def has_kind(directory: str, kind: str = "params") -> bool:
+    return os.path.exists(os.path.join(directory, f"{kind}_manifest.json"))
+
+
+def _leaf_slice(
+    directory: str,
+    kind: str,
+    saved_world: int,
+    files: Dict[int, Any],
+    leaf_index: int,
+    entry: Dict[str, Any],
+    start: int,
+    stop: int,
+) -> np.ndarray:
+    """[start, stop) of leaf `leaf_index`'s flat global data, reading only
+    the saved shards that overlap — the deterministic reshard step."""
+    dt = _np_dtype(entry["dtype"])
+    out = np.empty(stop - start, dtype=dt)
+    size = entry["size"]
+    for r in range(saved_world):
+        s0, s1 = shard_bounds(size, saved_world, r)
+        lo, hi = max(start, s0), min(stop, s1)
+        if lo >= hi:
+            continue
+        if r not in files:
+            path = os.path.join(directory, _shard_file(kind, r, saved_world))
+            files[r] = np.load(path)
+        raw = files[r][str(leaf_index)].view(dt)
+        out[lo - start : hi - start] = raw[lo - s0 : hi - s0]
+    return out
+
+
+def load_full(directory: str, kind: str = "params") -> Tuple[Any, Dict[str, Any]]:
+    """Reassembles the full global tree (host numpy leaves) from all saved
+    shards; world-size-agnostic by construction."""
+    import jax
+
+    t0 = time.perf_counter()
+    manifest = read_manifest(directory, kind)
+    with open(os.path.join(directory, f"{kind}_treedef.pkl"), "rb") as f:
+        treedef = pickle.load(f)
+    files: Dict[int, Any] = {}
+    leaves = []
+    for i, entry in enumerate(manifest["leaves"]):
+        flat = _leaf_slice(
+            directory, kind, manifest["world_size"], files, i, entry, 0, entry["size"]
+        )
+        leaves.append(flat.reshape(entry["shape"]))
+    _observe("load", t0)
+    return jax.tree_util.tree_unflatten(treedef, leaves), manifest
+
+
+def load_shard(
+    directory: str,
+    *,
+    world_size: int,
+    rank: int,
+    kind: str = "params",
+) -> Tuple[List[np.ndarray], Dict[str, Any]]:
+    """Rank `rank` of a NEW `world_size`'s flat slice of every leaf,
+    reading only the overlapping bytes of the saved world's shard files.
+    Returns (flat_slices_per_leaf, manifest) — pair with `load_full` /
+    `assemble` when the caller wants structured trees."""
+    t0 = time.perf_counter()
+    manifest = read_manifest(directory, kind)
+    files: Dict[int, Any] = {}
+    slices = []
+    for i, entry in enumerate(manifest["leaves"]):
+        start, stop = shard_bounds(entry["size"], world_size, rank)
+        slices.append(
+            _leaf_slice(
+                directory, kind, manifest["world_size"], files, i, entry, start, stop
+            )
+        )
+    _observe("load", t0)
+    return slices, manifest
+
+
+def reshard(src: str, dst: str, new_world_size: int, kind: str = "params") -> None:
+    """Rewrites a saved kind at a different world size without ever
+    materializing the full tree in one buffer: each new rank's slice is
+    read from the overlapping old shards and written straight out."""
+    t0 = time.perf_counter()
+    manifest = read_manifest(src, kind)
+    os.makedirs(dst, exist_ok=True)
+    files: Dict[int, Any] = {}
+    for r in range(new_world_size):
+        arrays = {}
+        for i, entry in enumerate(manifest["leaves"]):
+            start, stop = shard_bounds(entry["size"], new_world_size, r)
+            arrays[str(i)] = _leaf_slice(
+                src, kind, manifest["world_size"], files, i, entry, start, stop
+            ).view(np.uint8)
+        path = os.path.join(dst, _shard_file(kind, r, new_world_size))
+        with open(path + ".tmp", "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(path + ".tmp", path)
+    new_manifest = dict(manifest, world_size=int(new_world_size))
+    mpath = os.path.join(dst, f"{kind}_manifest.json")
+    with open(mpath + ".tmp", "w") as f:
+        json.dump(new_manifest, f, indent=1)
+    os.replace(mpath + ".tmp", mpath)
+    src_td = os.path.join(src, f"{kind}_treedef.pkl")
+    dst_td = os.path.join(dst, f"{kind}_treedef.pkl")
+    if os.path.abspath(src_td) != os.path.abspath(dst_td):
+        with open(src_td, "rb") as fin, open(dst_td + ".tmp", "wb") as fout:
+            fout.write(fin.read())
+        os.replace(dst_td + ".tmp", dst_td)
+    _observe("reshard", t0)
+
+
+# --------------------------------------------------- trainer-facing bundle
+
+
+def save_state(
+    directory: str,
+    params: Any,
+    opt_state: Any = None,
+    *,
+    step: int = 0,
+    world_size: int = 1,
+    rank: int = 0,
+    meta: Optional[Dict[str, Any]] = None,
+) -> None:
+    """One-call save of the training state pair: params (replicated
+    logical tree) + optimizer state (the ZeRO-sharded tree — pass the
+    GLOBAL logical tree, i.e. `zero.gather_opt_state` output or the
+    unsharded state; per-rank slicing is this format's job)."""
+    save_shards(
+        directory, params, kind="params", world_size=world_size, rank=rank,
+        step=step, meta=meta,
+    )
+    if opt_state is not None:
+        save_shards(
+            directory, opt_state, kind="opt", world_size=world_size, rank=rank,
+            step=step, meta=meta,
+        )
+
+
+def load_state(directory: str) -> Dict[str, Any]:
+    """Full-tree restore of a save_state checkpoint: dict with `params`,
+    `opt_state` (None when absent), `step`, `meta`, `saved_world_size`.
+    Device placement / ZeRO re-slicing happens on the caller's side — the
+    restore itself is world-size-agnostic."""
+    params, manifest = load_full(directory, "params")
+    opt_state = None
+    if has_kind(directory, "opt"):
+        opt_state, _ = load_full(directory, "opt")
+    return {
+        "params": params,
+        "opt_state": opt_state,
+        "step": manifest["step"],
+        "meta": manifest.get("meta", {}),
+        "saved_world_size": manifest["world_size"],
+    }

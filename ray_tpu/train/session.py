@@ -41,9 +41,16 @@ class TrainSession:
         local_rank: int = 0,
         trial_name: str = "",
         checkpoint: Optional[Checkpoint] = None,
+        target_world_size: Optional[int] = None,
     ):
         self.world_rank = world_rank
         self.world_size = world_size
+        # Elastic runs: the world size the user ASKED for. A loop can
+        # check `world_size < target_world_size` (degraded mode) to e.g.
+        # rescale its per-step token budget or log the deficit.
+        self.target_world_size = (
+            target_world_size if target_world_size is not None else world_size
+        )
         self.local_rank = local_rank
         self.trial_name = trial_name
         self._starting_checkpoint = checkpoint
@@ -298,6 +305,16 @@ class TrainContext:
     def get_world_size(self) -> int:
         s = get_session()
         return s.world_size if s else 1
+
+    def get_target_world_size(self) -> int:
+        """The world size the run was CONFIGURED for; larger than
+        get_world_size() while an elastic run is in degraded mode."""
+        s = get_session()
+        return s.target_world_size if s else 1
+
+    def is_degraded(self) -> bool:
+        s = get_session()
+        return bool(s) and s.world_size < s.target_world_size
 
     def get_local_rank(self) -> int:
         s = get_session()

@@ -39,9 +39,15 @@ from .worker_group import WorkerGroup
 # their own (bounded) budget instead of burning FailureConfig.max_failures.
 MAX_PREEMPTION_RETRIES = 16
 # How long fit() waits for replacement capacity after a preemption before
-# letting the next attempt fail on its own (the autoscaler's replace loop
+# downsizing (elastic) or failing fast with CapacityTimeoutError
+# (ScalingConfig.capacity_wait_s overrides; the autoscaler's replace loop
 # normally lands a slice well inside this).
 CAPACITY_WAIT_S = 120.0
+
+
+class _ElasticGrow(Exception):
+    """Internal control flow: capacity for the full target gang returned
+    and a checkpoint just landed — re-form the gang at target size."""
 
 
 @dataclasses.dataclass
@@ -117,16 +123,39 @@ class JaxTrainer:
         # (observability/goodput.py). Public for inspection/tests.
         self.goodput = _goodput.GoodputAccountant()
         restored = False  # next attempt recomputes lost steps first
+        sc = self.scaling_config
+        # Elastic world size: the gang the NEXT attempt launches with.
+        # Starts at target; _renegotiate_capacity moves it down when
+        # replacement capacity misses the wait budget, _ElasticGrow moves
+        # it back to target at a checkpoint boundary.
+        self._world_size = sc.num_workers
+        wait_budget = (
+            sc.capacity_wait_s if sc.capacity_wait_s is not None else CAPACITY_WAIT_S
+        )
 
         while True:
             try:
                 metrics = self._run_attempt(
-                    storage, manager, resume_ckpt, rework=restored
+                    storage, manager, resume_ckpt, rework=restored,
+                    world_size=self._world_size,
                 )
                 last_error = None
                 break
             except (KeyboardInterrupt, SystemExit):
                 raise  # user abort is not a training failure
+            except _ElasticGrow:
+                # Full-target capacity returned and a checkpoint just
+                # landed: re-form the gang at target size, resume
+                # same-step. Not a failure and not a preemption — it
+                # consumes neither retry budget.
+                metrics = getattr(self, "_last_metrics", {})
+                resume_ckpt = manager.latest_checkpoint or resume_ckpt
+                self._world_size = sc.num_workers
+                restored = True
+                imet.TRAIN_ELASTIC_RESIZES.inc(direction="growback")
+                _flight_record("train.elastic_growback", (sc.num_workers,))
+                if resume_ckpt is not None:
+                    imet.CHECKPOINTS_RESTORED.inc()
             except exc.PreemptionError as e:
                 # A preemption notice drained the gang: this is a
                 # capacity event, not a training failure — restore on the
@@ -148,7 +177,15 @@ class JaxTrainer:
                 )
                 # Waiting out replacement capacity is drain-wait time.
                 self.goodput.begin(_goodput.DRAIN_WAIT)
-                self._wait_for_capacity()
+                if not self._renegotiate_capacity(wait_budget):
+                    # No feasible gang inside the budget: fail fast with
+                    # the typed capacity error instead of launching a
+                    # doomed attempt against an empty cluster.
+                    err = self._capacity_error
+                    if err is not None:
+                        err.__cause__ = e
+                        last_error = err
+                    break
             except Exception as e:  # noqa: BLE001
                 last_error = e
                 metrics = getattr(self, "_last_metrics", {})
@@ -183,28 +220,95 @@ class JaxTrainer:
             error=last_error,
         )
 
-    def _wait_for_capacity(self, timeout_s: float = CAPACITY_WAIT_S) -> bool:
-        """Blocks until some alive, non-draining node could EVER host one
-        worker (total capacity, not current availability) — the restore
-        attempt after a preemption should start once the autoscaler's
-        replacement arrives, not burn retries against an empty cluster."""
-        need = dict(self.scaling_config.resources_per_worker or {"CPU": 1.0})
+    def _feasible_workers(self) -> int:
+        """How many gang workers the cluster could EVER host right now:
+        sum over alive, non-draining nodes of total-capacity fits (total,
+        not currently-available — the restore attempt frees its own
+        resources). Local mode reports the configured target (nothing to
+        negotiate against)."""
+        sc = self.scaling_config
+        need = dict(sc.resources_per_worker or {"CPU": 1.0})
         rt = runtime_base.current_runtime()
         if getattr(rt, "_gcs", None) is None:
+            return sc.num_workers
+        try:
+            nodes = rt.nodes()
+        except Exception:
+            return 0
+        # STRICT_SPREAD places at most one bundle per node: feasibility is
+        # the number of fitting NODES, not the sum of per-node fits —
+        # otherwise the renegotiation green-lights a world the placement
+        # group can never form and the attempt burns max_failures instead
+        # of downsizing.
+        one_per_node = sc.placement_strategy == "STRICT_SPREAD"
+        total = 0
+        for n in nodes:
+            if not n.get("Alive") or n.get("Draining"):
+                continue
+            res = n.get("Resources") or {}
+            fits = [int(res.get(k, 0.0) // v) for k, v in need.items() if v > 0]
+            per_node = max(0, min(fits)) if fits else 1
+            total += min(per_node, 1) if one_per_node else per_node
+        return total
+
+    def _wait_for_capacity(
+        self, n_workers: Optional[int] = None, timeout_s: float = CAPACITY_WAIT_S
+    ) -> bool:
+        """Blocks until the cluster can host an `n_workers` gang. Wakes on
+        node_events (node_added / node_draining / node_dead published by
+        the GCS) with a 1 s re-check as fallback — not a 4 Hz node-table
+        poll."""
+        need = n_workers if n_workers is not None else self.scaling_config.num_workers
+        rt = runtime_base.current_runtime()
+        gcs = getattr(rt, "_gcs", None)
+        if gcs is None:
             return True  # local mode: nothing to wait for
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        watcher: Optional[NodeEventWatcher] = None
+        try:
             try:
-                nodes = rt.nodes()
+                watcher = NodeEventWatcher(gcs)
             except Exception:
-                nodes = []
-            for n in nodes:
-                if not n.get("Alive") or n.get("Draining"):
-                    continue
-                total = n.get("Resources") or {}
-                if all(total.get(k, 0.0) >= v for k, v in need.items()):
+                watcher = None
+            deadline = time.monotonic() + timeout_s
+            while True:
+                if self._feasible_workers() >= need:
                     return True
-            time.sleep(0.25)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                if watcher is not None:
+                    watcher.wait_for_event(min(1.0, remaining))
+                else:
+                    time.sleep(min(0.5, remaining))
+        finally:
+            if watcher is not None:
+                watcher.stop()
+
+    def _renegotiate_capacity(self, timeout_s: float) -> bool:
+        """After a preemption: wait for FULL target capacity; on timeout
+        either enter the elastic downsize path (largest feasible world
+        >= min_workers) or record a CapacityTimeoutError. Returns True
+        when fit() should launch the next attempt (self._world_size is
+        set), False to fail fast (self._capacity_error is set)."""
+        sc = self.scaling_config
+        target = sc.num_workers
+        self._capacity_error: Optional[exc.CapacityTimeoutError] = None
+        if self._wait_for_capacity(target, timeout_s=timeout_s):
+            self._world_size = target
+            return True
+        feasible = self._feasible_workers()
+        if sc.elastic and feasible >= sc.elastic_floor:
+            new_world = min(feasible, target)
+            _flight_record(
+                "train.elastic_downsize", (self._world_size, new_world, target)
+            )
+            imet.TRAIN_ELASTIC_RESIZES.inc(direction="downsize")
+            self._world_size = new_world
+            return True
+        self._capacity_error = exc.CapacityTimeoutError(
+            target, feasible, timeout_s, sc.elastic_floor if sc.elastic else 0
+        )
+        _flight_record("train.capacity_timeout", (target, feasible, timeout_s))
         return False
 
     @staticmethod
@@ -218,12 +322,13 @@ class JaxTrainer:
             if aid in ids and nid
         }
 
-    def _use_distributed(self) -> bool:
+    def _use_distributed(self, world_size: Optional[int] = None) -> bool:
         """Multi-host rendezvous requires process-isolated workers (one jax
         runtime per worker); the thread-based local runtime shares one
         process, so it keeps the local-mesh path."""
         sc = self.scaling_config
-        if sc.backend is None and sc.num_workers <= 1:
+        n = world_size if world_size is not None else sc.num_workers
+        if sc.backend is None and n <= 1:
             return False
         from ..core import runtime_base
         from ..core.local_runtime import LocalRuntime
@@ -237,6 +342,7 @@ class JaxTrainer:
         manager: CheckpointManager,
         resume_ckpt: Optional[Checkpoint],
         rework: bool = False,
+        world_size: Optional[int] = None,
     ) -> Dict[str, Any]:
         import cloudpickle
 
@@ -250,15 +356,35 @@ class JaxTrainer:
         acct.begin(_goodput.RESTART_REWORK if rework else _goodput.SETUP)
 
         sc = self.scaling_config
+        ws = world_size if world_size is not None else sc.num_workers
+        trial = storage.trial_name or storage.experiment_name
+        # Elastic visibility: the live world-size gauge plus degraded-mode
+        # accounting — an attempt below target runs in the DEGRADED
+        # goodput category, credited at world/target (half the chips
+        # productive is half the goodput).
+        imet.TRAIN_WORLD_SIZE.set(float(ws), trial=trial)
+        productive_cat = _goodput.PRODUCTIVE
+        if ws < sc.num_workers:
+            productive_cat = _goodput.DEGRADED
+            acct.set_weight(_goodput.DEGRADED, ws / max(1, sc.num_workers))
         pg = None
-        if sc.num_workers > 1:
-            bundles = [dict(sc.resources_per_worker or {"CPU": 1}) for _ in range(sc.num_workers)]
+        if ws > 1:
+            bundles = [dict(sc.resources_per_worker or {"CPU": 1}) for _ in range(ws)]
             pg = create_pg(bundles, strategy=sc.placement_strategy)
+            # Gang re-forms (restore, grow-back) race the PREVIOUS gang's
+            # async teardown: the old workers' resources free a beat after
+            # kill(). Wait for the bundles instead of scheduling workers
+            # against a pending group ("bundle not available").
+            if not pg.ready(timeout=60.0):
+                raise RuntimeError(
+                    f"placement group for {ws}-worker gang not ready in 60s"
+                )
 
         group = WorkerGroup(
-            sc.num_workers,
+            ws,
             resources_per_worker=sc.resources_per_worker,
             placement_group=pg,
+            target_world_size=sc.num_workers,
         )
         self._last_metrics: Dict[str, Any] = {}
         # Preemption awareness: subscribe to node_draining notices and
@@ -268,7 +394,7 @@ class JaxTrainer:
         watcher: Optional[NodeEventWatcher] = None
         gang_nodes: Set[str] = set()
         gcs = getattr(runtime_base.current_runtime(), "_gcs", None)
-        if gcs is not None and sc.num_workers >= 1:
+        if gcs is not None and ws >= 1:
             try:
                 watcher = NodeEventWatcher(gcs)
                 gang_nodes = self._gang_nodes(gcs, group)
@@ -282,7 +408,7 @@ class JaxTrainer:
             #    backend config): every worker-process rendezvouses via
             #    jax.distributed.initialize and builds the GLOBAL mesh;
             #  - single host: each worker builds the local-device mesh.
-            if self._use_distributed():
+            if self._use_distributed(ws):
                 import os
 
                 from .backend import JaxBackendConfig, coordinator_address
@@ -384,10 +510,11 @@ class JaxTrainer:
                 ]
                 if not live:
                     continue  # every worker is mid-step; poll again
-                if not drained and acct.category != _goodput.PRODUCTIVE:
+                if not drained and acct.category != productive_cat:
                     # First fresh result of this attempt: steps are
-                    # advancing — setup/rework ends here.
-                    acct.begin(_goodput.PRODUCTIVE)
+                    # advancing — setup/rework ends here (DEGRADED when
+                    # the gang is below target).
+                    acct.begin(productive_cat)
                 rank0 = (
                     results[0]
                     if results[0] is not None and not results[0].get("__pending__")
@@ -402,14 +529,22 @@ class JaxTrainer:
                     manager.register(persisted, self._last_metrics)
                     ckpt_index += 1
                     if not drained:
-                        acct.begin(_goodput.PRODUCTIVE)
+                        acct.begin(productive_cat)
                     # Live goodput gauge each checkpoint: the
                     # goodput_floor watchdog is about runs IN PROGRESS
                     # (fit()'s terminal set is one-shot).
-                    imet.TRAIN_GOODPUT.set(
-                        acct.fraction(),
-                        trial=storage.trial_name or storage.experiment_name,
-                    )
+                    imet.TRAIN_GOODPUT.set(acct.fraction(), trial=trial)
+                    if (
+                        ws < sc.num_workers
+                        and not drained
+                        and self._feasible_workers() >= sc.num_workers
+                    ):
+                        # Grow-back at the checkpoint boundary: the
+                        # autoscaler delivered target capacity while this
+                        # degraded gang was running, and the checkpoint
+                        # that just persisted is the same-step resume
+                        # point for the full-size gang.
+                        raise _ElasticGrow()
 
             try:
                 api.get([w.join.remote() for w in group.workers])

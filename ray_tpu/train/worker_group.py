@@ -21,9 +21,10 @@ from .session import TrainSession, get_session, init_session, shutdown_session
 class _TrainWorker:
     """Actor body hosting one training worker (one host's SPMD process)."""
 
-    def __init__(self, rank: int, world_size: int):
+    def __init__(self, rank: int, world_size: int, target_world_size: int = 0):
         self.rank = rank
         self.world_size = world_size
+        self.target_world_size = target_world_size or world_size
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._mesh = None
@@ -98,6 +99,7 @@ class _TrainWorker:
                 world_size=self.world_size,
                 trial_name=trial_name,
                 checkpoint=ckpt,
+                target_world_size=self.target_world_size,
             )
         except BaseException as e:  # noqa: BLE001
             # Fire-and-forget launches discard this call's ref: record the
@@ -213,8 +215,10 @@ class WorkerGroup:
         num_workers: int,
         resources_per_worker: Optional[Dict[str, float]] = None,
         placement_group=None,
+        target_world_size: int = 0,
     ):
         self.num_workers = num_workers
+        self.target_world_size = target_world_size or num_workers
         opts: Dict[str, Any] = {"max_concurrency": 4}
         res = dict(resources_per_worker or {})
         if "CPU" in res:
@@ -232,8 +236,11 @@ class WorkerGroup:
                     placement_group=placement_group, placement_group_bundle_index=rank
                 )
             self._workers.append(
-                worker_cls.options(**w_opts).remote(rank, num_workers) if w_opts
-                else worker_cls.remote(rank, num_workers)
+                worker_cls.options(**w_opts).remote(
+                    rank, num_workers, self.target_world_size
+                )
+                if w_opts
+                else worker_cls.remote(rank, num_workers, self.target_world_size)
             )
         # Barrier on construction.
         api.get([w.ping.remote() for w in self._workers])

@@ -648,6 +648,25 @@ TRAIN_GOODPUT = Gauge(
     component="train",
     tag_keys=("trial",),
 )
+TRAIN_WORLD_SIZE = Gauge(
+    "raytpu_train_world_size",
+    "Current training gang world size (elastic runs move below target)",
+    component="train",
+    tag_keys=("trial",),
+)
+TRAIN_RESHARD_TIME = Histogram(
+    "raytpu_train_reshard_ms",
+    "Elastic checkpoint save/load/reshard durations, by operation",
+    component="train",
+    boundaries=[1, 5, 10, 50, 100, 500, 1000, 5000, 10000, 60000],
+    tag_keys=("op",),
+)
+TRAIN_ELASTIC_RESIZES = Counter(
+    "raytpu_train_elastic_resizes_total",
+    "Elastic gang renegotiations, by direction (downsize / growback)",
+    component="train",
+    tag_keys=("direction",),
+)
 RL_ENV_STEPS = Counter(
     "raytpu_rl_env_steps_total",
     "Environment steps sampled by env runners",

@@ -28,9 +28,12 @@ class NodeEventWatcher:
         self._gcs = gcs
         self._poll_timeout_s = poll_timeout_s
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._event_count = 0
         self._seq = 0
         self.draining: Set[str] = set()
         self.dead: Set[str] = set()
+        self.added: Set[str] = set()
         # Grows only: nodes that EVER received a drain notice. `draining`
         # reflects current state (a dead node leaves it); supervisors
         # distinguishing "noticed preemption" from "un-noticed crash"
@@ -72,6 +75,12 @@ class NodeEventWatcher:
                         self.dead.add(nid)
                         # A dead node is no longer "draining" — it's gone.
                         self.draining.discard(nid)
+                    elif msg.get("event") == "node_added":
+                        self.added.add(nid)
+                        self.dead.discard(nid)
+                if entries:
+                    self._event_count += len(entries)
+                    self._cond.notify_all()
 
     def affected(self, node_ids) -> Set[str]:
         """The subset of `node_ids` that is draining or dead."""
@@ -92,6 +101,16 @@ class NodeEventWatcher:
         it concurrently — callers must not iterate the live set)."""
         with self._lock:
             return set(self.draining)
+
+    def wait_for_event(self, timeout_s: float) -> bool:
+        """Blocks until ANY node event lands (or timeout) — the
+        event-driven half of a capacity wait: wake on node_added/
+        node_draining/node_dead, re-check the predicate, repeat. Returns
+        True when an event arrived inside the window."""
+        with self._cond:
+            before = self._event_count
+            self._cond.wait(timeout_s)
+            return self._event_count != before
 
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:

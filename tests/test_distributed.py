@@ -82,6 +82,9 @@ def _fit(rtpu, tmp_path, num_workers, backend, expect_devices, name):
     return trainer.fit()
 
 
+# ~75 s: real jax.distributed 2-process rendezvous + full parity run —
+# genuinely slow, moved out of the tier-1 wall (run with -m slow).
+@pytest.mark.slow
 def test_trainer_multihost_loss_parity(rt, tmp_path):
     """2 worker processes x 4 virtual devices rendezvous via
     jax.distributed.initialize into an 8-device global mesh and train to

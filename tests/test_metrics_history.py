@@ -392,7 +392,7 @@ def test_goodput_accountant_classification():
     snap = acct.snapshot()
     assert snap["seconds"] == {
         SETUP: 2.0, PRODUCTIVE: 12.0, CHECKPOINT: 1.0,
-        DRAIN_WAIT: 3.0, RESTART_REWORK: 2.0,
+        DRAIN_WAIT: 3.0, RESTART_REWORK: 2.0, "degraded": 0.0,
     }
     assert snap["goodput"] == pytest.approx(12.0 / 20.0)
     with pytest.raises(ValueError):

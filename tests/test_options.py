@@ -14,7 +14,9 @@ import ray_tpu as rt
 from ray_tpu import exceptions as exc
 
 
-@pytest.fixture
+# Module-scoped: one cluster boot for the whole file (assertions here
+# are cumulative-tolerant: >= counts and any() lookups).
+@pytest.fixture(scope="module")
 def rt_cluster():
     rt.shutdown()
     rt.init(num_cpus=4, num_workers=2)
@@ -213,8 +215,11 @@ class TestConcurrencyGroups:
         compute_wall = _time.monotonic() - t0
         assert compute_wall >= 0.55, f"compute group overlapped: {compute_wall:.2f}s"
 
-    def test_local_mode(self, rt_local):
-        self._run(rt_local)
-
+    # cluster mode FIRST: rt_local boots a local-mode runtime, which
+    # shuts down the module-scoped cluster fixture — nothing may use
+    # rt_cluster after a local-mode test in this file.
     def test_cluster_mode(self, rt_cluster):
         self._run(rt_cluster)
+
+    def test_local_mode(self, rt_local):
+        self._run(rt_local)

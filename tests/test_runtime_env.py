@@ -50,6 +50,8 @@ def test_py_modules(rt, tmp_path):
         import rtpu_testmod  # noqa: F401 — must NOT leak into the driver
 
 
+# ~45 s: builds a real pip venv — genuinely slow (run with -m slow).
+@pytest.mark.slow
 def test_pip_venv_isolated_package(rt, tmp_path):
     """pip: the worker runs inside a per-env virtualenv with the requested
     package installed (offline: a local source package; system

@@ -20,7 +20,11 @@ from ray_tpu.core.placement_group import (
 )
 
 
-@pytest.fixture
+# Module-scoped: the 5-node boot is ~12 s and dominated this file's wall
+# time. Each test removes its placement group so slices are whole again
+# for the next; the node-killing test runs LAST (file order, ordering
+# plugins disabled in tier-1).
+@pytest.fixture(scope="module")
 def two_slices():
     """Head (no TPU) + two 2-host slices with 4 chips per host."""
     rt.shutdown()
@@ -48,17 +52,24 @@ def _slice_of(nodes, node_id):
 
 
 def test_gang_lands_on_one_slice(two_slices):
+    from ray_tpu.core.placement_group import remove_placement_group
+
     cluster, runtime, nodes = two_slices
     pg = placement_group(
         [{"CPU": 1.0, "TPU": 4.0}, {"CPU": 1.0, "TPU": 4.0}], strategy="SLICE_GANG"
     )
-    placed = [pg.bundle_placements[0], pg.bundle_placements[1]]
-    slices = {_slice_of(nodes, n) for n in placed}
-    assert len(slices) == 1 and None not in slices, f"gang split across {slices}"
-    assert len(set(placed)) == 2  # one bundle per host
+    try:
+        placed = [pg.bundle_placements[0], pg.bundle_placements[1]]
+        slices = {_slice_of(nodes, n) for n in placed}
+        assert len(slices) == 1 and None not in slices, f"gang split across {slices}"
+        assert len(set(placed)) == 2  # one bundle per host
+    finally:
+        remove_placement_group(pg)
 
 
 def test_gang_worker_sees_visible_chips(two_slices):
+    from ray_tpu.core.placement_group import remove_placement_group
+
     cluster, runtime, nodes = two_slices
     pg = placement_group([{"CPU": 1.0, "TPU": 4.0}], strategy="SLICE_GANG")
 
@@ -70,17 +81,20 @@ def test_gang_worker_sees_visible_chips(two_slices):
             os.environ.get("TPU_WORKER_ID"),
         )
 
-    chips, slice_name, worker_id = rt.get(
-        read_tpu_env.options(
-            scheduling_strategy=PlacementGroupSchedulingStrategy(
-                placement_group=pg, placement_group_bundle_index=0
-            )
-        ).remote(),
-        timeout=60,
-    )
-    assert chips == "0,1,2,3"
-    assert slice_name in ("slice-a", "slice-b")
-    assert worker_id in ("0", "1")
+    try:
+        chips, slice_name, worker_id = rt.get(
+            read_tpu_env.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=0
+                )
+            ).remote(),
+            timeout=60,
+        )
+        assert chips == "0,1,2,3"
+        assert slice_name in ("slice-a", "slice-b")
+        assert worker_id in ("0", "1")
+    finally:
+        remove_placement_group(pg)
 
 
 def test_member_death_cofails_and_reschedules(two_slices):

@@ -12,7 +12,9 @@ import ray_tpu as rt
 from ray_tpu.utils import state
 
 
-@pytest.fixture
+# Module-scoped: one cluster boot for the whole file (assertions here
+# are cumulative-tolerant: >= counts and any() lookups).
+@pytest.fixture(scope="module")
 def rt_cluster():
     rt.shutdown()
     rt.init(num_cpus=4, num_workers=2)

@@ -70,6 +70,12 @@ REQUIRED = {
     "ray_tpu.chaos",
     "ray_tpu.chaos.controller",
     "ray_tpu.utils.node_events",
+    # The elastic-training modules import into every training worker
+    # (ray_tpu.train re-exports them) and the cgraph elastic wrapper
+    # into every gang driver — a backend init here would wedge restores.
+    "ray_tpu.train.elastic_checkpoint",
+    "ray_tpu.train.zero",
+    "ray_tpu.cgraph.elastic",
 }
 
 
