@@ -446,6 +446,65 @@ def bench_logging_overhead_guard(min_time: float) -> None:
     )
 
 
+def bench_lock_order_overhead_guard(min_time: float) -> None:
+    """Lock-order detector overhead guard.
+
+    Armed (RAY_TPU_LOCK_ORDER=1, as tier-1 runs), every control-plane
+    lock acquire pays the Python wrapper + per-thread stack bookkeeping;
+    that must cost <2% of no-op task dispatch. Disarmed (the shipped
+    default) must be FREE: the factories return plain stdlib locks, so
+    there is no wrapper to measure — asserted structurally plus a lock
+    µbench."""
+    import os
+    import threading
+
+    from ray_tpu.utils import lock_order as lo
+
+    prior = os.environ.get(lo.ENV_VAR)
+    rates = {"off": 0.0, "on": 0.0}
+    try:
+        # Disarmed is free by construction: plain stdlib lock, no wrapper.
+        os.environ.pop(lo.ENV_VAR, None)
+        assert type(lo.tracked_lock("bench.probe")) is type(threading.Lock())
+        assert type(lo.tracked_rlock("bench.probe")) is type(threading.RLock())
+
+        # Interleaved best-of-2 boots per config: boot-to-boot drift on a
+        # small box otherwise dwarfs a 2% budget (same protocol as the
+        # history/watchdog guard).
+        for _trial in range(2):
+            for label, flag in (("off", None), ("on", "1")):
+                if flag is None:
+                    os.environ.pop(lo.ENV_VAR, None)
+                else:
+                    os.environ[lo.ENV_VAR] = flag
+                rt.init(num_cpus=8, num_workers=2, object_store_memory=256 << 20)
+                rates[label] = max(rates[label], _sync_dispatch_rate(min_time))
+                rt.shutdown()
+    finally:
+        if prior is None:
+            os.environ.pop(lo.ENV_VAR, None)
+        else:
+            os.environ[lo.ENV_VAR] = prior
+    ratio = rates["on"] / rates["off"] if rates["off"] else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "lock_order_overhead",
+                "value": round(ratio, 3),
+                "unit": "x (armed/disarmed sync dispatch)",
+                "vs_baseline": None,
+                "on_ops_s": round(rates["on"], 1),
+                "off_ops_s": round(rates["off"], 1),
+            }
+        ),
+        flush=True,
+    )
+    assert ratio >= 0.98, (
+        f"armed lock-order instrumentation cost {100 * (1 - ratio):.1f}% of "
+        f"no-op dispatch (budget: 2%) — {rates}"
+    )
+
+
 def bench_chaos_overhead_guard(min_time: float) -> None:
     """Chaos injection-point overhead guard.
 
@@ -927,6 +986,7 @@ def main():
     bench_chaos_overhead_guard(min_time)
     bench_history_watchdog_overhead_guard(min_time)
     bench_logging_overhead_guard(min_time)
+    bench_lock_order_overhead_guard(min_time)
     # Very last (it asserts the >=2x ZeRO shrink contract): a failure here
     # must not mask the overhead guards above.
     bench_elastic()

@@ -28,8 +28,11 @@ from typing import Any, Dict, List, Optional
 
 from ..chaos.controller import maybe_inject as _chaos_inject
 from ..observability.flight_recorder import record as _flight_record
+from ..observability.logs import get_logger as _get_logger
 from .gce import TPU_REST_URL, HttpTransport, gce_access_token
 from .tpu import parse_pod_type
+
+_log = _get_logger("accelerators")
 
 
 class NodeProvider:
@@ -107,15 +110,17 @@ class LocalNodeProvider(NodeProvider):
                 self._gcs().call(
                     "report_preemption", nid, deadline_s, "spot preemption (injected)"
                 )
-            except Exception:
-                pass  # notice is best-effort, termination is not
+            except Exception as e:
+                # Notice is best-effort, termination is not — but a lost
+                # notice degrades graceful drain into blunt node death.
+                _log.warning("preemption notice for %s failed: %r", nid[:12], e)
 
         def _terminate():
             time.sleep(max(0.0, deadline_s))
             for nid in nodes:
                 try:
                     self._cluster.remove_node(nid)
-                except Exception:
+                except Exception:  # lint: swallow-ok(node already gone at preemption deadline)
                     pass
             with self._lock:
                 cur = self._instances.get(cloud_id)
@@ -168,7 +173,7 @@ class LocalNodeProvider(NodeProvider):
             for nid in nodes:
                 try:
                     self._cluster.remove_node(nid)
-                except Exception:
+                except Exception:  # lint: swallow-ok(partial-slice teardown is best-effort per node)
                     pass
             with self._lock:
                 rec = self._instances.get(cloud_id)
@@ -182,7 +187,7 @@ class LocalNodeProvider(NodeProvider):
                 for nid in nodes:
                     try:
                         self._cluster.remove_node(nid)
-                    except Exception:
+                    except Exception:  # lint: swallow-ok(nobody wants these nodes; removal best-effort)
                         pass
                 return
             rec["nodes"] = nodes
@@ -227,7 +232,7 @@ class LocalNodeProvider(NodeProvider):
         for nid in (rec or {}).get("nodes", ()):
             try:
                 self._cluster.remove_node(nid)
-            except Exception:
+            except Exception:  # lint: swallow-ok(terminate of an already-dead node)
                 pass
 
 
@@ -417,14 +422,15 @@ class GceTpuNodeProvider(NodeProvider):
                     self._gcs.call(
                         "report_preemption", n["NodeID"], 0.0, "cloud preemption"
                     )
-                except Exception:
-                    pass
+                except Exception as e:
+                    _log.warning("cloud preemption relay for %s failed: %r",
+                                 n["NodeID"][:12], e)
 
     def _safe_delete(self, cloud_id: str) -> None:
         try:
             self._call("DELETE", f"{self._base()}/{cloud_id}")
-        except Exception:
-            pass  # already gone / API hiccup: poll reports it next round
+        except Exception:  # lint: swallow-ok(already gone / API hiccup; poll reports next round)
+            pass
 
     def ray_node_for(self, cloud_id: str) -> Optional[str]:
         if self._gcs is None:

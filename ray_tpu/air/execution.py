@@ -60,7 +60,7 @@ class ActorManager:
         if kill:
             try:
                 api.kill(tracked.handle)
-            except Exception:
+            except Exception:  # lint: swallow-ok(actor may already be dead)
                 pass
 
     @property

@@ -85,7 +85,7 @@ class LocalTPUSliceProvider(LocalNodeProvider, TPUSliceProvider):
             for nid in nodes:
                 try:
                     self.terminate_node(nid)
-                except Exception:
+                except Exception:  # lint: swallow-ok(partial-slice teardown best-effort; original error re-raised)
                     pass
             raise
         return nodes
@@ -142,7 +142,13 @@ class Autoscaler:
             try:
                 self.step()
             except Exception:
-                pass  # transient control-plane hiccup; retry next tick
+                # Transient control-plane hiccup; retried next tick — but a
+                # persistently failing autoscaler must not fail silently.
+                from .observability.logs import get_logger
+
+                get_logger("autoscaler").warning(
+                    "autoscaler step failed", exc_info=True
+                )
 
     # -------------------------------------------------------------- logic
     def step(self) -> None:
@@ -215,7 +221,7 @@ class Autoscaler:
                 # ready() poll would get there anyway.
                 try:
                     gcs.call("retry_pending_placement_group", pg_id)
-                except Exception:
+                except Exception:  # lint: swallow-ok(advisory nudge; waiter poll gets there anyway)
                     pass
 
         # ---- downscale: managed nodes idle past the timeout

@@ -200,7 +200,7 @@ class FakeCloudProvider(CloudProvider):
         if rec and rec["node_id"]:
             try:
                 self._cluster.remove_node(rec["node_id"])
-            except Exception:
+            except Exception:  # lint: swallow-ok(node already gone)
                 pass
 
 
@@ -261,7 +261,13 @@ class InstanceManager:
         try:
             cloud = self._provider.poll()
         except Exception:
-            pass  # provider hiccup: drive off the last view next round
+            # Drive off the last view this round — but a provider that
+            # stays unreachable must be visible, not silently stale.
+            from .observability.logs import get_logger
+
+            get_logger("autoscaler").warning(
+                "provider poll failed; reconciling on stale view", exc_info=True
+            )
 
         # None = GCS unreachable (no information; keep prior judgement);
         # an EMPTY set is a real observation (all nodes dead).
@@ -311,8 +317,8 @@ class InstanceManager:
                     try:
                         self._provider.terminate(inst.cloud_id)
                         inst.to(TERMINATED)
-                    except Exception:
-                        pass  # retry next round
+                    except Exception:  # lint: swallow-ok(terminate retried next reconcile round)
+                        pass
 
             # 2. Retry failed allocations after backoff.
             for inst in list(self.instances.values()):
@@ -366,7 +372,7 @@ class InstanceManager:
         if inst.cloud_id:
             try:
                 self._provider.terminate(inst.cloud_id)
-            except Exception:
+            except Exception:  # lint: swallow-ok(failed-allocation cleanup; poll reconciles leftovers)
                 pass
             inst.cloud_id = None
         self._retry_at[inst.instance_id] = now + self.retry_backoff_s * (

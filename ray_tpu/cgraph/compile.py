@@ -178,7 +178,7 @@ class CompiledGraph:
                         h._invoke("__ray_dag_stop__", (self._dag_id,), {}, 1),
                         timeout=10,
                     )
-                except Exception:
+                except Exception:  # lint: swallow-ok(unwinding a failed compile; actors may be half-started)
                     pass
             for comm in self._comms:
                 comm.destroy()
@@ -381,14 +381,12 @@ class CompiledGraph:
         for h in self._handles.values():
             try:
                 api.get(h._invoke("__ray_dag_stop__", (self._dag_id,), {}, 1), timeout=30)
-            except Exception:
-                pass  # actor may already be dead
+            except Exception:  # lint: swallow-ok(actor may already be dead)
+                pass
         for comm in self._comms:
             try:
                 comm.destroy()
-            except Exception:
-                # A comm whose gang lost a member must not abort teardown
-                # mid-way (writers/readers below still need closing).
+            except Exception:  # lint: swallow-ok(gang lost a member; teardown must finish)
                 pass
         for _, w in self._in_writers:
             w.close()

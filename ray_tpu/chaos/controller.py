@@ -226,8 +226,8 @@ class ChaosController:
             from ..utils import internal_metrics as imet
 
             imet.CHAOS_INJECTIONS.inc(point=point, action=rule.action)
-        except Exception:
-            pass  # metrics must never break the injection itself
+        except Exception:  # lint: swallow-ok(metrics must never break the injection itself)
+            pass
         try:
             # The structured log stream gets the injection too: `ray-tpu
             # logs --component chaos` shows a campaign's faults inline
@@ -237,7 +237,7 @@ class ChaosController:
             get_logger("chaos").warning(
                 "injecting %s at %s (%s)", rule.action, point, detail
             )
-        except Exception:
+        except Exception:  # lint: swallow-ok(logging must never break the injection itself)
             pass
 
     def stats(self) -> List[Dict[str, Any]]:
@@ -310,12 +310,12 @@ def kill_now(point: str, detail: str = "") -> None:
         from ..observability import flight_recorder as _frec
 
         _frec.dump(reason=f"chaos kill at {point}: {detail}")
-    except Exception:
+    except Exception:  # lint: swallow-ok(pre-SIGKILL dump is best-effort by design)
         pass
     try:
         from ..utils import internal_metrics as imet
 
         imet._flush_once()
-    except Exception:
+    except Exception:  # lint: swallow-ok(pre-SIGKILL metric flush is best-effort by design)
         pass
     os.kill(os.getpid(), signal.SIGKILL)

@@ -189,16 +189,65 @@ class _Group:
         rdv_timeout = _rendezvous_timeout()
 
         def do_accept():
-            try:
-                self._srv.settimeout(rdv_timeout)
-                conn, _ = self._srv.accept()
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                # Peer announces its rank; the ring only expects prev.
-                hello = pickle.loads(_recv_msg(conn))
-                accepted["conn"] = conn if hello == (self.rank - 1) % self.world_size else None
-                accepted["rank"] = hello
-            except Exception as e:  # noqa: BLE001
-                accepted["err"] = e
+            # Loop until the true prev rank completes a handshake: a
+            # connector that timed out waiting for our ack (we were slow to
+            # start accepting) abandons its connection, and that dead
+            # socket sits in OUR backlog ahead of its retry — a single
+            # accept() would return it, hit EOF, and fail the whole
+            # rendezvous while the peer is still retrying.
+            prev_rank = (self.rank - 1) % self.world_size
+            accept_deadline = time.monotonic() + rdv_timeout
+            self._srv.settimeout(1.0)  # poll so the loop honors the deadline
+            while time.monotonic() < accept_deadline:
+                try:
+                    conn, _ = self._srv.accept()
+                except socket.timeout:
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    accepted["err"] = e
+                    return
+                try:
+                    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    # A stalled/half-open connection must not wedge the
+                    # drain loop past the deadline: accepted sockets do NOT
+                    # inherit the listener timeout.
+                    conn.settimeout(
+                        max(0.1, min(5.0, accept_deadline - time.monotonic()))
+                    )
+                    # Peer announces its rank; the ring only expects prev.
+                    hello = pickle.loads(_recv_msg(conn))
+                    accepted["rank"] = hello
+                    if hello != prev_rank:
+                        conn.close()  # wrong peer: refuse (no ack), keep accepting
+                        continue
+                    # 3-way handshake. Ack the hello: a connector is only
+                    # DONE once its acceptor answered — a connect that
+                    # landed in a stale listener's TCP backlog (same-name
+                    # re-init) "succeeds" at the TCP level, so without the
+                    # ack the connector stops retrying and this side's
+                    # accept starves (the reinit flake). Then REQUIRE the
+                    # connector's ring-go: an ABANDONED backlog conn can
+                    # still serve a readable hello (data queued before FIN)
+                    # and swallow the ack without error — only a peer that
+                    # actually read the ack sends ring-go, so a dead conn
+                    # times out/EOFs here and the drain continues to the
+                    # live retry.
+                    _send_msg(conn, pickle.dumps(("ring-ack", self.rank)))
+                    go = pickle.loads(_recv_msg(conn))
+                    if go != ("ring-go", prev_rank):
+                        conn.close()
+                        continue
+                    conn.settimeout(None)
+                    accepted["conn"] = conn
+                    return
+                except Exception:  # noqa: BLE001
+                    # Dead/abandoned backlog connection: drop it, keep
+                    # accepting — the live peer is still retrying.
+                    try:
+                        conn.close()
+                    except OSError:  # lint: swallow-ok(closing an already-dead backlog conn)
+                        pass
+            accepted["err"] = socket.timeout("ring accept deadline")
 
         t = threading.Thread(target=do_accept, daemon=True)
         t.start()
@@ -206,6 +255,7 @@ class _Group:
         deadline = time.monotonic() + rdv_timeout
         last = None
         addr = None
+        s = None
         while time.monotonic() < deadline:
             # Re-resolve the neighbor EVERY retry: after an actor restart
             # the KV may briefly hold the dead incarnation's address, and
@@ -221,17 +271,43 @@ class _Group:
                 continue
             try:
                 s = socket.create_connection(addr, timeout=2.0)
-                break
-            except OSError as e:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                s.settimeout(5.0)
+                _send_msg(s, pickle.dumps(self.rank))
+                # Wait for the acceptor's ack (see do_accept): dead-backlog
+                # connects die here with EOF/RST/timeout and we re-resolve
+                # instead of silently wedging the ring.
+                tag, peer = pickle.loads(_recv_msg(s))
+                if tag == "ring-ack" and peer == next_rank:
+                    # Final confirm: tells the acceptor this connection is
+                    # live (it discards acked-but-unconfirmed dead conns).
+                    _send_msg(s, pickle.dumps(("ring-go", self.rank)))
+                    s.settimeout(None)
+                    break
+                raise OSError(f"bad ring ack from {addr}: {(tag, peer)!r}")
+            except (OSError, EOFError, ConnectionError, socket.timeout) as e:
                 last = e
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                    s = None
                 time.sleep(0.1)
         else:
             self._fail_rendezvous(f"cannot reach next rank at {addr}: {last}")
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _send_msg(s, pickle.dumps(self.rank))
         self._next = s
         t.join(timeout=rdv_timeout)
         err = accepted.get("err")
+        if isinstance(err, (socket.timeout, TimeoutError)) and "rank" in accepted:
+            # Somebody dialed but no handshake with the expected prev ever
+            # completed: still a rendezvous timeout (typed, flight-recorded)
+            # with the who-dialed detail appended.
+            self._fail_rendezvous(
+                f"prev rank {(self.rank - 1) % self.world_size} never completed "
+                f"the ring handshake within {rdv_timeout}s "
+                f"(last hello from rank {accepted.get('rank')})"
+            )
         if isinstance(err, (socket.timeout, TimeoutError)) or (
             err is None and "rank" not in accepted
         ):
@@ -378,7 +454,7 @@ class _Group:
             cur = self._gcs.call("kv_get", key)
             if cur is not None and cur.decode() == getattr(self, "_addr_str", None):
                 self._gcs.call("kv_del", key)
-        except Exception:
+        except Exception:  # lint: swallow-ok(guarded key delete; GCS down means keys die with it)
             pass
         for s in (self._next, self._prev, self._srv):
             if s is not None:
@@ -520,7 +596,7 @@ def _clear_stale_registrations(group_name: str) -> None:
     try:
         for key in gcs.call("kv_keys", f"{_KV_PREFIX}{group_name}/"):
             gcs.call("kv_del", key)
-    except Exception:
+    except Exception:  # lint: swallow-ok(best-effort sweep; rendezvous guards against stale keys)
         pass
 
 
@@ -554,11 +630,11 @@ def destroy_collective_group_on(actors, group_name: str = "default") -> None:
             # A DEAD member raises at SUBMIT time (fastpath channel knows
             # the incarnation is gone before any get) — its membership
             # state died with the worker; skip it, destroy the rest.
-            pass
+            pass  # lint: swallow-ok(dead member; destroy the rest)
     try:
         api.get(refs, timeout=60)
-    except Exception:
-        pass  # members may already be dead; their keys are guard-deleted
+    except Exception:  # lint: swallow-ok(members may already be dead; keys are guard-deleted)
+        pass
     # No blanket key sweep here: each member's destroy() deletes its own
     # key only while it still holds that member's address, so a same-name
     # group being re-created concurrently keeps its fresh registrations

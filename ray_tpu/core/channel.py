@@ -418,8 +418,8 @@ class ChannelWriter:
                 self._m_msgs = imet.CGRAPH_CHANNEL_MSGS.labels(channel=metrics_label)
                 self._m_bytes = imet.CGRAPH_CHANNEL_BYTES.labels(channel=metrics_label)
                 self._m_hwm = imet.CGRAPH_RING_HWM.labels(channel=metrics_label)
-            except Exception:
-                pass  # instrumentation must never break the data plane
+            except Exception:  # lint: swallow-ok(instrumentation must never break the data plane)
+                pass
         deadline = time.monotonic() + connect_timeout
         last: Optional[Exception] = None
         while time.monotonic() < deadline:

@@ -33,6 +33,7 @@ import cloudpickle
 
 from .. import exceptions as exc
 from .. import tracing as _tracing
+from ..observability.logs import get_logger as _get_logger
 from ..utils.config import CONFIG
 from .ids import ActorID, ObjectID, TaskID
 from .object_transport import StoredError
@@ -40,6 +41,8 @@ from .rpc import RpcClient
 from .runtime_base import Runtime
 from .shm_store import SharedMemoryStore
 from .task_spec import ArgRef, TaskSpec, TaskType
+
+_log = _get_logger("driver")
 
 
 def _entry_from_spec(spec: TaskSpec) -> dict:
@@ -160,6 +163,10 @@ class ClusterRuntime(Runtime):
         # reference_count.h:64, task_manager.h:208). return-oid hex ->
         # shared _TaskRecord; pruned when the last local ref to any of the
         # task's outputs drops.
+        # NOT tracked: the ref-count lock sits on the per-ObjectRef fast
+        # path (~15 acquires per dispatch); the wrapper would cost ~10%
+        # tasks/s. Cross-plane deadlock coverage comes from the raylet/
+        # GCS/serve-controller locks, which are off the fastpath.
         self._ref_lock = threading.Lock()
         self._local_refs: Dict[str, int] = {}
         self._owned: set = set()  # oids this process created (put / submit)
@@ -181,7 +188,7 @@ class ClusterRuntime(Runtime):
         # submit_task_batch message (reference: NormalTaskSubmitter's
         # submission queue). A dedicated flusher keeps single submits at
         # one-thread-handoff latency while a tight loop batches naturally.
-        self._submit_lock = threading.Lock()
+        self._submit_lock = threading.Lock()  # fastpath; see _ref_lock note
         self._submit_buf: List[dict] = []
         self._submit_wake = threading.Event()
         threading.Thread(target=self._submit_loop, daemon=True, name="submit").start()
@@ -192,7 +199,7 @@ class ClusterRuntime(Runtime):
 
         self._fastpath = FastPath(self)
         self._actor_channels: Dict[str, Any] = {}
-        self._actor_channels_lock = threading.Lock()
+        self._actor_channels_lock = threading.Lock()  # fastpath; see _ref_lock note
         self._cancelled_tids: set = set()
         # Fast-path completion wakeups: the worker's in-band ack marks the
         # outputs sealed, waking local get()s milliseconds before the
@@ -498,8 +505,9 @@ class ClusterRuntime(Runtime):
                         self._raylet.notify("notify_object", h)
                         blob2 = self._memstore.pop(h)
                         self._memstore_bytes -= len(blob2)
-                    except Exception:
-                        pass  # keep the blob; better a leak than data loss
+                    except Exception as e:  # keep the blob; better a leak than data loss
+                        _log.warning("could not escape %s to shm; keeping in-memory copy: %r",
+                                     h[:8], e)
                 if h not in self._escaped:
                     # No other process can hold a borrow (the ref never left
                     # this one): free the pool block now so the allocator
@@ -528,7 +536,7 @@ class ClusterRuntime(Runtime):
                     # Pinned readers make delete fail; the async GCS free
                     # path (which the raylet monitor retries) covers those.
                     self._store.delete(ObjectID.from_hex(h))
-                except Exception:
+                except Exception:  # lint: swallow-ok(pinned readers; async GCS free path retries)
                     pass
         if freed:
             self._free_wake.set()
@@ -761,8 +769,8 @@ class ClusterRuntime(Runtime):
                 if oid.hex() not in ready_h:
                     try:
                         self._maybe_recover(oid, store_errors=True)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        _log.debug("recovery nudge for %s failed: %r", oid.hex()[:8], e)
         ready_idx = [i for i, h in enumerate(hexes) if h in ready_h][:num_returns]
         ready_set = set(ready_idx)
         return ready_idx, [i for i in range(len(ids)) if i not in ready_set]
@@ -833,8 +841,10 @@ class ClusterRuntime(Runtime):
                     pre_pressure=self.flush_local_frees,
                 )
                 self._raylet.notify("notify_object", rid)
-            except Exception:
-                pass
+            except Exception as e:
+                # A missing error object turns a clean failure into a hung
+                # get(): this loss must be loud.
+                _log.warning("failed to store fastpath error object: %r", e)
 
     def _fastpath_failed(self, entries: List[dict]) -> None:
         """A leased worker died with these tasks outstanding: retry via the
@@ -929,8 +939,9 @@ class ClusterRuntime(Runtime):
                 for entry in batch:
                     try:
                         self._store_error_object(entry, e)
-                    except Exception:
-                        pass
+                    except Exception as store_err:
+                        _log.warning("failed to store submit-error object for %s: %r",
+                                     entry.get("task_id", "?")[:8], store_err)
 
     # --------------------------------------------- streaming returns
     def stream_next(self, task_id, index: int, timeout: Optional[float] = None):
@@ -969,7 +980,7 @@ class ClusterRuntime(Runtime):
                     self._raylet.call(
                         "wait_objects", [h_item, h_header], 1, 0.2, True, timeout=10.0
                     )
-                except Exception:
+                except Exception:  # lint: swallow-ok(advisory remote check; producer-death net below)
                     pass
                 # Producer-death safety net: the header's task record drives
                 # retry/reconstruct or raises ObjectLostError — without this
@@ -1022,8 +1033,8 @@ class ClusterRuntime(Runtime):
                         self._owned.add(h)
                         self._local_refs[h] = self._local_refs.get(h, 0) + 1
                     self.remove_local_ref(oid)
-        except Exception:
-            pass  # abandoned stream cleanup is best effort
+        except Exception:  # lint: swallow-ok(abandoned stream cleanup is best effort)
+            pass
 
     def object_future(self, object_id: ObjectID) -> concurrent.futures.Future:
         fut: concurrent.futures.Future = concurrent.futures.Future()
@@ -1202,8 +1213,8 @@ class ClusterRuntime(Runtime):
                 self._raylet.call(
                     "cancel_lease_task", rec.entry["_fast"], tid, force
                 )
-            except Exception:
-                pass
+            except Exception as e:
+                _log.debug("cancel_lease_task for %s failed: %r", tid[:8], e)
             return
         # Task events are batch-flushed (~0.2s): wait briefly for the
         # holding node to be known; if it stays unknown (early cancel of a
@@ -1227,7 +1238,7 @@ class ClusterRuntime(Runtime):
             if n.get("Alive"):
                 try:
                     self._raylet_for(n["sock"]).call("cancel_task", tid, force)
-                except Exception:
+                except Exception:  # lint: swallow-ok(node may be dead; cancel is best-effort per node)
                     pass
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
@@ -1293,7 +1304,7 @@ class ClusterRuntime(Runtime):
                 try:
                     if self._gcs.call("retry_pending_placement_group", pg_id):
                         return True
-                except Exception:
+                except Exception:  # lint: swallow-ok(poller-driven retry; next poll covers it)
                     pass
             if deadline is None or time.monotonic() >= deadline:
                 return info is not None and info.get("state") == "CREATED"
@@ -1315,7 +1326,7 @@ class ClusterRuntime(Runtime):
                 channels = list(self._actor_channels.values())
             for ch in channels:
                 ch.close()
-        except Exception:
+        except Exception:  # lint: swallow-ok(best-effort channel close during shutdown)
             pass
         if self._driver and self._procs:
             for node in self.nodes():
@@ -1326,11 +1337,11 @@ class ClusterRuntime(Runtime):
                     continue
                 try:
                     self._raylet_for(node["sock"]).call("stop", timeout=2.0)
-                except Exception:
+                except Exception:  # lint: swallow-ok(shutdown stop is best-effort; SIGKILL below)
                     pass
             try:
                 self._gcs.call("stop", timeout=2.0)
-            except Exception:
+            except Exception:  # lint: swallow-ok(shutdown stop is best-effort; SIGKILL below)
                 pass
             time.sleep(0.1)
             for p in self._procs:
@@ -1602,7 +1613,7 @@ class Cluster:
             proc.wait(timeout=5.0)
         try:
             RpcClient(self.gcs_sock).call("drain_node", node_id)
-        except Exception:
+        except Exception:  # lint: swallow-ok(test harness remove_node; GCS health check catches it)
             pass
 
     def runtime(self) -> ClusterRuntime:

@@ -138,8 +138,8 @@ class DirectConn:
                 self.lessor.notify(
                     "return_worker_lease", self.worker_id, self.lease_token
                 )
-            except Exception:
-                pass  # raylet gone; its successor holds no such lease
+            except Exception:  # lint: swallow-ok(raylet gone; its successor holds no such lease)
+                pass
 
     def _reader(self) -> None:
         while True:
@@ -190,7 +190,7 @@ class DirectConn:
         if pending:
             try:
                 self._on_dead(pending)
-            except Exception:
+            except Exception:  # lint: swallow-ok(failure callback on a dying channel; callee logs)
                 pass
 
 

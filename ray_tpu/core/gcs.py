@@ -22,8 +22,13 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ..exceptions import ActorNameTakenError, PlacementGroupError, SchedulingError
+from ..utils import lock_order
+from ..observability.logs import get_logger as _get_logger
 from ..utils import internal_metrics as imet
 from ..utils.config import CONFIG
+
+_log = _get_logger("gcs")
 
 HEARTBEAT_TIMEOUT_S = CONFIG.heartbeat_timeout_s
 
@@ -40,7 +45,7 @@ TASK_TABLE_CAP = 50_000
 
 class GcsService:
     def __init__(self, snapshot_path: Optional[str] = None):
-        self._lock = threading.RLock()
+        self._lock = lock_order.tracked_rlock("gcs.state")
         self._snapshot_path = snapshot_path
         self._nodes: Dict[str, dict] = {}
         self._actors: Dict[str, dict] = {}
@@ -173,8 +178,14 @@ class GcsService:
             rec = pickle.dumps((table, key, copy.copy(value)))
             self._wal_f.write(len(rec).to_bytes(4, "little") + rec)
             self._wal_f.flush()
-        except Exception:
-            pass  # durability is best-effort between snapshots
+        except Exception as e:
+            # Durability is best-effort between snapshots, but a WAL that
+            # stopped persisting (disk full, unpicklable value) must be
+            # visible once — silently running without it turns the next
+            # GCS restart into state loss.
+            if not getattr(self, "_wal_warned", False):
+                self._wal_warned = True
+                _log.warning("WAL append failed; durability degraded to snapshots: %r", e)
 
     def _replay_wal(self) -> None:
         import pickle
@@ -360,8 +371,9 @@ class GcsService:
         if sock:
             try:
                 self._raylet_call(sock, "drain", deadline_s)
-            except Exception:
-                pass
+            except Exception as e:
+                _log.debug("drain RPC to %s failed (node may already be gone): %r",
+                           sock, e)
         return True
 
     def _announce_draining(self, node_id: str, deadline_s: float, reason: str) -> None:
@@ -751,7 +763,7 @@ class GcsService:
                 if cli is not None:
                     try:
                         cli.close()
-                    except Exception:
+                    except Exception:  # lint: swallow-ok(closing a client to a dead node)
                         pass
             for locs in self._objects.values():
                 locs.discard(node_id)
@@ -830,13 +842,13 @@ class GcsService:
             # both pass the uniqueness check while pick_node runs (TOCTOU).
             with self._lock:
                 if key in self._named:
-                    raise ValueError(f"actor name {name!r} already taken")
+                    raise ActorNameTakenError(f"actor name {name!r} already taken")
                 self._named[key] = actor_id
         try:
             if pg_id:
                 node = self.pick_bundle(pg_id, bundle_index)
                 if node is None:
-                    raise RuntimeError(
+                    raise PlacementGroupError(
                         f"placement group {pg_id[:8]} bundle {bundle_index} not available"
                     )
             else:
@@ -865,11 +877,11 @@ class GcsService:
                             node = feasible[self._overflow_rr % len(feasible)]
                 if node is None:
                     if _is_hard_affinity(strategy):
-                        raise RuntimeError(
+                        raise SchedulingError(
                             f"hard NodeAffinity to {strategy.split(':')[1][:12]} "
                             f"cannot be satisfied for actor requiring {resources}"
                         )
-                    raise RuntimeError(
+                    raise SchedulingError(
                         f"no node can EVER host actor requiring {resources}"
                     )
         except BaseException:
@@ -1051,8 +1063,8 @@ class GcsService:
         for sock, hs in by_node.items():
             try:
                 self._raylet_call(sock, "delete_objects", hs)
-            except Exception:
-                pass  # node going away frees its pool anyway
+            except Exception:  # lint: swallow-ok(node going away frees its pool anyway)
+                pass
 
     def update_borrows(self, deltas: Dict[str, int]) -> bool:
         """Batched borrow-count adjustments from non-owner processes."""
@@ -1123,7 +1135,7 @@ class GcsService:
         if stale and node_sock:
             try:
                 self._raylet_call(node_sock, "delete_objects", stale)
-            except Exception:
+            except Exception:  # lint: swallow-ok(stale-object GC retried by the monitor)
                 pass
         return True
 
@@ -1231,8 +1243,9 @@ class GcsService:
         imet.ERROR_REPORTS.inc()
         try:
             self.pubsub_publish("error_reports", payload)
-        except Exception:
-            pass
+        except Exception as e:
+            _log.warning("error-report publish failed (subscribers missed %r): %r",
+                         payload.get("type"), e)
         return True
 
     def cluster_errors(self, limit: int = 100) -> List[dict]:
@@ -1287,7 +1300,7 @@ class GcsService:
                         chosen = nid
                         break
             if chosen is None:
-                raise RuntimeError(
+                raise PlacementGroupError(
                     f"cannot place bundle {i} ({bundle}) with strategy {strategy}"
                 )
             take(chosen, bundle)
@@ -1330,7 +1343,7 @@ class GcsService:
                 placements.append(chosen)
             if len(placements) == len(bundles):
                 return placements
-        raise RuntimeError(
+        raise PlacementGroupError(
             f"no registered TPU slice can host all {len(bundles)} bundles atomically"
         )
 
@@ -1352,7 +1365,7 @@ class GcsService:
             if sock:
                 try:
                     self._raylet_call(sock, "release_bundle", pg_id, i)
-                except Exception:
+                except Exception:  # lint: swallow-ok(bundle release on a dead/gone node)
                     pass
         try:
             self.create_placement_group(pg_id, bundles, "SLICE_GANG")
@@ -1370,7 +1383,7 @@ class GcsService:
         All-or-nothing: any failed lease rolls the gang back."""
         with self._lock:
             if pg_id in self._removed_pgs:
-                raise RuntimeError(f"placement group {pg_id[:8]} was removed")
+                raise PlacementGroupError(f"placement group {pg_id[:8]} was removed")
         banned: Set[str] = set()
         last_err: Optional[str] = None
         for _ in range(4):  # replanning rounds for stale-view refusals
@@ -1407,7 +1420,7 @@ class GcsService:
                                 node = self._nodes.get(nid)
                                 if node:
                                     node["available"] = dict(avail)
-                        except Exception:
+                        except Exception:  # lint: swallow-ok(advisory resource-view refresh)
                             pass
                 with self._lock:
                     removed = pg_id in self._removed_pgs
@@ -1430,9 +1443,9 @@ class GcsService:
                         if sock:
                             try:
                                 self._raylet_call(sock, "release_bundle", pg_id, i)
-                            except Exception:
+                            except Exception:  # lint: swallow-ok(bundle release on a dead/gone node)
                                 pass
-                    raise RuntimeError(f"placement group {pg_id[:8]} was removed")
+                    raise PlacementGroupError(f"placement group {pg_id[:8]} was removed")
                 return {"placements": placements}
             # Roll back partial gang, ban the refusing node, replan.
             for nid, i in reserved:
@@ -1442,11 +1455,11 @@ class GcsService:
                 if sock:
                     try:
                         self._raylet_call(sock, "release_bundle", pg_id, i)
-                    except Exception:
+                    except Exception:  # lint: swallow-ok(bundle release on a dead/gone node)
                         pass
             banned.add(failed_node)
             last_err = f"node {failed_node[:8]} refused bundle lease"
-        raise RuntimeError(f"placement group {pg_id[:8]} creation failed: {last_err}")
+        raise PlacementGroupError(f"placement group {pg_id[:8]} creation failed: {last_err}")
 
     def _raylet_call(self, sock: str, method: str, *args):
         """Cached per-raylet client for control-plane calls (bundle
@@ -1488,7 +1501,7 @@ class GcsService:
                 if sock:
                     try:
                         self._raylet_call(sock, "release_bundle", pg_id, i)
-                    except Exception:
+                    except Exception:  # lint: swallow-ok(bundle release on a dead/gone node)
                         pass
         return True
 

@@ -55,7 +55,7 @@ class ObjectRef:
         if rt is not None:
             try:
                 rt.remove_local_ref(self._id)
-            except Exception:
+            except Exception:  # lint: swallow-ok(__del__ during interpreter teardown)
                 pass
 
     def __hash__(self):
@@ -128,5 +128,5 @@ class ObjectRefGenerator:
         try:
             if not self._done:
                 self._rt.stream_done(self._task_id)
-        except Exception:
+        except Exception:  # lint: swallow-ok(__del__ during interpreter teardown)
             pass

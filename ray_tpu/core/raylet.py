@@ -33,6 +33,7 @@ from .. import exceptions as exc
 from .. import tracing as _tracing
 from ..chaos.controller import kill_now as _chaos_kill
 from ..chaos.controller import maybe_inject as _chaos_inject
+from ..utils import lock_order
 from ..observability.flight_recorder import record as _flight_record
 from ..observability.logs import get_logger as _get_logger
 from ..utils import internal_metrics as imet
@@ -131,7 +132,7 @@ class RayletService:
                 self.labels.setdefault("tpu_version", spec.version)
                 if spec.topology:
                     self.labels.setdefault("tpu_topology", spec.topology)
-        self._res_lock = threading.Lock()
+        self._res_lock = lock_order.tracked_lock("raylet.resources")
         # Placement-group bundle reservations hosted on this node:
         # (pg_id, bundle_index) -> {"reserved": {...}, "free": {...}}.
         # Reserved resources are deducted from `available`, so heartbeats
@@ -145,7 +146,7 @@ class RayletService:
         # the raylet holds the lease's resources until it is returned
         # (reference: HandleRequestWorkerLease, node_manager.cc:1797).
         self._leases: Dict[str, Dict[str, Any]] = {}
-        self._workers_lock = threading.Lock()
+        self._workers_lock = lock_order.tracked_lock("raylet.workers")
         self._max_task_workers = max(1, int(resources.get("CPU", 1)))
         # Task ids with cancel intent (reference: core_worker CancelTask ->
         # raylet queued-task removal). Bounded FIFO: broadcast cancels leave
@@ -160,7 +161,7 @@ class RayletService:
         self._seen_submits: "collections.OrderedDict[Tuple[str, int], List[bytes]]" = (
             collections.OrderedDict()
         )
-        self._seen_lock = threading.Lock()
+        self._seen_lock = lock_order.tracked_lock("raylet.seen_submits")
 
         self._pending: "queue.Queue" = queue.Queue()  # task entries
         # Wakes the dispatch loop on any schedulability change (new task,
@@ -170,7 +171,7 @@ class RayletService:
         self._sched_wake = threading.Event()
         self._waiting: List[dict] = []  # dep-blocked entries
         self._actors: Dict[str, dict] = {}  # actor_id -> {worker_id, queue, state}
-        self._actor_lock = threading.Lock()
+        self._actor_lock = lock_order.tracked_lock("raylet.actors")
 
         self._remote_raylets: Dict[str, RpcClient] = {}
         self._stop = threading.Event()
@@ -210,7 +211,7 @@ class RayletService:
         # batched in the reference too, src/ray/core_worker/task_event_buffer.h).
         self._loc_buf: List[str] = []
         self._evt_buf: List[dict] = []
-        self._buf_lock = threading.Lock()
+        self._buf_lock = lock_order.tracked_lock("raylet.gcs_sync_buf")
         self._buf_wake = threading.Event()
         # Objects whose delete hit a reader pin; retried by the monitor loop
         # (guarded by _buf_lock: mutated from RPC handler threads).
@@ -229,11 +230,11 @@ class RayletService:
         os.makedirs(self._log_dir, exist_ok=True)
         self._local_objects: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
         self._spilled: Dict[str, str] = {}
-        self._spill_lock = threading.Lock()
+        self._spill_lock = lock_order.tracked_lock("raylet.spill")
         # Serializes whole evict/spill/restore sequences: concurrent
         # ensure_space RPC threads must not unlink each other's fresh
         # spill files.
-        self._evict_lock = threading.Lock()
+        self._evict_lock = lock_order.tracked_lock("raylet.evict")
 
         self._threads = [
             threading.Thread(target=self._scheduler_loop, daemon=True, name="sched"),
@@ -329,7 +330,9 @@ class RayletService:
                 with self._buf_lock:  # GCS briefly unreachable: retry later
                     self._loc_buf = locs + self._loc_buf
                     self._evt_buf = evts + self._evt_buf
-                time.sleep(0.5)
+                # Stop-aware backoff: a plain sleep would hold shutdown
+                # hostage for the full backoff (blocking-in-loop lint).
+                self._stop.wait(0.5)
 
     # ------------------------------------------------------------ helpers
     def _remote(self, sock: str) -> RpcClient:
@@ -414,7 +417,7 @@ class RayletService:
                     continue
                 try:
                     tpu = json.loads(w.env_key).get("tpu")
-                except Exception:
+                except Exception:  # lint: swallow-ok(malformed env_key means no chip lease)
                     continue
                 if tpu and chips.intersection(tpu.get("chips", ())):
                     victims.append(w)
@@ -624,8 +627,8 @@ class RayletService:
                         return self._remote(target["sock"]).call(
                             "submit_task", blob(), True
                         )
-                except Exception:
-                    pass
+                except Exception as e:
+                    _log.debug("spillback failed, queuing locally: %r", e)
         entry["type"] = "task"
         self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
         self._enqueue(entry)
@@ -689,8 +692,8 @@ class RayletService:
             if target is not None and target["node_id"] != self.node_id:
                 self._remote(target["sock"]).call("submit_task", spec_blob, True)
                 return
-        except Exception:
-            pass
+        except Exception as e:
+            _log.debug("spread placement failed, queuing locally: %r", e)
         entry["type"] = "task"
         self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
         self._enqueue(entry)
@@ -709,8 +712,8 @@ class RayletService:
                 try:
                     self._remote(target["sock"]).call("submit_task", spec_blob, True)
                     return
-                except Exception:
-                    pass  # target died mid-forward; retry placement
+                except Exception:  # lint: swallow-ok(target died mid-forward; retried until deadline)
+                    pass
             time.sleep(0.1)
         self._store_error_for(
             entry, RuntimeError(f"no node can satisfy {resources}")
@@ -879,7 +882,7 @@ class RayletService:
                         return True
                 except exc.ObjectStoreFullError:
                     break  # pins may drop; retry within the deadline
-                except Exception:
+                except Exception:  # lint: swallow-ok(one dead location; try the next replica)
                     continue
             if self.store.contains(oid):
                 return True
@@ -1059,9 +1062,7 @@ class RayletService:
                 self._remote(head).notify(
                     "push_object", oid_hex, self.advertised, rest
                 )
-            except Exception:
-                # Root unreachable: its subtree still self-heals via the
-                # normal pull path when consumers ask for the object.
+            except Exception:  # lint: swallow-ok(subtree self-heals via the pull path)
                 pass
 
     def start_broadcast(self, oid_hex: str) -> int:
@@ -1173,7 +1174,7 @@ class RayletService:
                     self._local_objects.pop(h, None)
                 try:
                     self.gcs.call("remove_object_location", h, self.node_id)
-                except Exception:
+                except Exception:  # lint: swallow-ok(directory heals via node_sync batches)
                     pass
                 return True
             return False  # pinned by a reader
@@ -1209,7 +1210,7 @@ class RayletService:
         target = max(0, int(self.store.capacity() * 0.95) - int(nbytes))
         try:
             self.gcs.call("flush_frees")
-        except Exception:
+        except Exception:  # lint: swallow-ok(advisory pre-pressure; eviction below is the guarantee)
             pass
         if self.store.bytes_in_use() <= target:
             return True
@@ -1672,8 +1673,8 @@ class RayletService:
                         self._log_dir,
                         protect_prefixes=live + ["gcs", "raylet_", "zygote"],
                     )
-                except Exception:
-                    pass
+                except Exception as e:
+                    _log.debug("log-dir GC failed this round: %r", e)
 
     def _worker_log_tail(self, worker_id: str, n_lines: int = 50) -> str:
         """The last captured output lines of one worker (its .out/.err
@@ -1889,8 +1890,11 @@ class RayletService:
                 except Exception as sched_err:  # noqa: BLE001
                     try:
                         self._store_error_for(e, sched_err)
-                    except Exception:
-                        pass
+                    except Exception as store_err:
+                        # The error object is load-bearing: without it the
+                        # caller's get() hangs, so its loss must be loud.
+                        _log.warning("could not store scheduling error for %s: %r",
+                                     e.get("task_id", "?")[:8], store_err)
             self._waiting = still
             imet.SCHED_QUEUE_DEPTH.set(len(still) + self._pending.qsize())
 
@@ -2274,8 +2278,11 @@ class RayletService:
                     self.ensure_space(e.nbytes)
                     self.store.put(oid, err_obj)
                 sealed.append(oid.hex())
-            except Exception:
-                pass
+            except Exception as put_err:
+                # Same contract as the comment above: a return slot with no
+                # error object hangs the caller — make the loss visible.
+                _log.warning("failed to store error object for %s: %r",
+                             oid.hex()[:8], put_err)
         self._notify_sealed(sealed)
         self._task_event(entry["task_id"], "FAILED", reason=str(error))
 
@@ -2349,7 +2356,7 @@ class RayletService:
                                     "log_tail": tail[-4000:],
                                 },
                             )
-                        except Exception:
+                        except Exception:  # lint: swallow-ok(postmortem report is best-effort; death handling below is the guarantee)
                             pass
                 tail_note = f"; last output:\n{tail[-2000:]}" if tail else ""
                 if entry is not None:
@@ -2489,8 +2496,10 @@ class RayletService:
                             self.total,
                             self.labels,
                         )
-            except Exception:
-                pass
+            except Exception as e:
+                # Missed heartbeats are how this node gets declared dead:
+                # say so while it is still alive to say anything.
+                _log.debug("heartbeat to GCS failed (retried next tick): %r", e)
 
     def ping(self) -> str:
         return "pong"

@@ -136,12 +136,12 @@ class RpcServer:
                         t0 = time.perf_counter() if observe else 0.0
                         try:
                             getattr(server_self.service, method)(*args, **kwargs)
-                        except BaseException:  # noqa: BLE001
+                        except BaseException:  # noqa: BLE001  # lint: swallow-ok(one-way submit; errors surface as stored error objects)
                             pass
                         if observe:
                             try:
                                 observe(method, (time.perf_counter() - t0) * 1e3)
-                            except Exception:
+                            except Exception:  # lint: swallow-ok(metrics hook must not break RPC)
                                 pass
                         continue
                     t0 = time.perf_counter() if observe else 0.0
@@ -157,7 +157,7 @@ class RpcServer:
                     if observe:
                         try:
                             observe(method, (time.perf_counter() - t0) * 1e3)
-                        except Exception:
+                        except Exception:  # lint: swallow-ok(metrics hook must not break RPC)
                             pass
                     try:
                         _send_msg(sock, reply)

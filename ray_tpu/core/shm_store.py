@@ -92,7 +92,7 @@ class _Pin:
     def __del__(self):
         try:
             self.release()
-        except Exception:
+        except Exception:  # lint: swallow-ok(interpreter teardown; segment GC covers it)
             pass
 
 
@@ -353,7 +353,7 @@ class SharedMemoryStore:
                 if pre_pressure is not None:
                     try:
                         pre_pressure()
-                    except Exception:
+                    except Exception:  # lint: swallow-ok(advisory pre-pressure; ensure_space below is the guarantee)
                         pass
                 raylet.call("ensure_space", e.nbytes)
                 try:

@@ -173,7 +173,7 @@ def main(argv: List[str]) -> None:
             import jax as _jax
 
             _jax.config.update("jax_platforms", jp)
-        except Exception:
+        except Exception:  # lint: swallow-ok(platform pin is best-effort; env var also set)
             pass
     runtime_env = json.loads(os.environ.get("RAY_TPU_RUNTIME_ENV", "{}") or "{}")
     _apply_working_dir(runtime_env)
@@ -372,7 +372,7 @@ def main(argv: List[str]) -> None:
                     "traceback": _tb.format_exc()[-4000:],
                 },
             )
-        except Exception:
+        except Exception:  # lint: swallow-ok(crash postmortem is best-effort; error object is the contract)
             pass
 
     def store_error(entry: dict, err: BaseException, sealed: List[str]) -> None:
@@ -387,7 +387,7 @@ def main(argv: List[str]) -> None:
                     for h in entry["return_ids"]:
                         inline[h] = blob
                     return
-            except Exception:
+            except Exception:  # lint: swallow-ok(inline pack failed; store path below is the fallback)
                 pass
         for h in entry["return_ids"]:
             rid = ObjectID.from_hex(h)
@@ -402,8 +402,11 @@ def main(argv: List[str]) -> None:
                     pre_pressure=runtime.flush_local_frees,
                 )
                 sealed.append(rid.hex())
-            except Exception:
-                pass
+            except Exception as store_err:
+                # A return slot with no error object hangs the caller's
+                # get(); the loss must be loud in the worker log.
+                _wlog.warning("failed to store error object %s: %r",
+                              rid.hex()[:8], store_err)
 
     def bind_method(inst, name: str):
         """User method, or a framework builtin for reserved names — the
@@ -911,7 +914,7 @@ def main(argv: List[str]) -> None:
                                     sender(("r",))
                                 except OSError:
                                     pass
-                    except Exception:
+                    except Exception:  # lint: swallow-ok(lease-poll hiccup; next poll retries)
                         pass
                 continue
             _dlog(f"exec {entry.get('task_id','?')[:8]}")

@@ -54,7 +54,7 @@ def set_pdeathsig(sig: int = signal.SIGTERM) -> None:
         return
     try:
         _PRCTL(_PR_SET_PDEATHSIG, int(sig), 0, 0, 0)
-    except Exception:
+    except Exception:  # lint: swallow-ok(prctl unavailable; ppid watchdog is the fallback)
         pass
 
 
@@ -157,7 +157,7 @@ def main(sock_path: str) -> None:
             pid = _spawn(req)
             f.write((json.dumps({"pid": pid}) + "\n").encode())
             f.flush()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001  # lint: swallow-ok(one bad spawn request must not kill the zygote server)
             pass
         finally:
             try:

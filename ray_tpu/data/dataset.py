@@ -89,7 +89,7 @@ def _apply_fused(block: Block, ops: List[_Op]) -> Block:
         # counts INPUT rows — the work the operator actually performed.
         try:
             imet.DATA_ROWS.inc(acc.num_rows(), operator=op.kind)
-        except Exception:
+        except Exception:  # lint: swallow-ok(metrics must not break the data plane)
             pass
         if op.kind == "map_rows":
             block = block_from_rows([op.fn(r) for r in acc.iter_rows()])
@@ -464,7 +464,7 @@ class Dataset:
             for a in state["actors"]:
                 try:
                     api.kill(a)
-                except Exception:
+                except Exception:  # lint: swallow-ok(pool actor may already be dead)
                     pass
 
         sop = StreamOp(
@@ -837,7 +837,7 @@ def _stable_hash(v: Any) -> int:
         # map-side partitions disagree with reduce-side Python equality.
         try:
             v = v.item()
-        except Exception:
+        except Exception:  # lint: swallow-ok(non-scalar .item(); value used as-is)
             pass
     if isinstance(v, (bool, int, float)) and not isinstance(v, float):
         try:

@@ -180,7 +180,14 @@ class StreamingExecutor:
                     try:
                         op.on_end()
                     except Exception:
-                        pass
+                        # A failing user end-hook must not mask the
+                        # pipeline result, but silence hides leaks (the
+                        # hook usually releases actors/files).
+                        from ..observability.logs import get_logger
+
+                        get_logger("data").warning(
+                            "stream operator on_end hook failed", exc_info=True
+                        )
 
     def _poll_completions(self) -> bool:
         moved = False
@@ -291,5 +298,5 @@ class StreamingExecutor:
             return
         try:
             api.wait(all_inflight, num_returns=1, timeout=0.2)
-        except Exception:
+        except Exception:  # lint: swallow-ok(bounded idle wait; completion poll follows)
             pass

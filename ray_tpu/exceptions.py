@@ -157,5 +157,19 @@ class RuntimeEnvSetupError(RayTpuError):
     pass
 
 
-class PlacementGroupError(RayTpuError):
-    pass
+class PlacementGroupError(RayTpuError, RuntimeError):
+    """A placement group could not be created, was removed mid-wait, or a
+    bundle lease was refused. Subclasses RuntimeError so pre-taxonomy
+    callers (and the GCS's own pending-PG retry) keep catching it."""
+
+
+class SchedulingError(RayTpuError, RuntimeError):
+    """No node can satisfy a task/actor's resource or affinity demand —
+    a permanent infeasibility, not transient load (the scheduler queues
+    for load; it raises this only when no node could EVER host the
+    request). Subclasses RuntimeError for pre-taxonomy callers."""
+
+
+class ActorNameTakenError(RayTpuError, ValueError):
+    """An actor name/namespace pair is already claimed. Subclasses
+    ValueError to match the reference's get_actor/naming error shape."""

@@ -32,7 +32,9 @@ def build_library(name: str, sources: list[str], extra_flags: list[str] | None =
             + ["-lpthread"]
             + (extra_flags or [])
         )
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        # The lock exists precisely to serialize concurrent builders on the
+        # one output file; nothing latency-sensitive contends on it.
+        subprocess.run(cmd, check=True, capture_output=True, text=True)  # lint: disable=blocking-in-loop
     return out
 
 

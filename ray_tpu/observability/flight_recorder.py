@@ -39,6 +39,24 @@ from typing import Any, Dict, List, Optional
 
 _DEFAULT_SIZE = 4096
 
+# Catalog of event-kind prefixes (the segment before the first "."):
+# dump consumers (`ray-tpu trace`, the chaos acceptance tests) group and
+# filter by prefix, so an undeclared prefix is invisible to them. The
+# metric-catalog lint rule (tools/lint) checks every literal record()
+# kind against this set — add the prefix here when adding a new
+# subsystem's events.
+KIND_PREFIXES = {
+    "cgraph",    # compiled-graph exec loop + recompile
+    "chan",      # core/channel.py reads/writes/timeouts
+    "chaos",     # chaos controller injections
+    "coll",      # collective rendezvous/ops
+    "lock",      # utils/lock_order.py order-cycle / long-hold reports
+    "node",      # node lifecycle (drain notices)
+    "sched",     # raylet scheduler queue/dispatch
+    "train",     # trainer drain/restore/elastic transitions
+    "watchdog",  # SLO watchdog alerts
+}
+
 
 def _enabled() -> bool:
     return os.environ.get("RAY_TPU_FLIGHT_RECORDER") != "0"
@@ -182,7 +200,7 @@ def install_crash_hooks(role: str = "") -> None:
             # shutdown path raised before doing any work): skip the file.
             if RECORDER.snapshot():
                 RECORDER.dump(reason=f"crash[{role}]: {tp.__name__}: {val}")
-        except Exception:
+        except Exception:  # lint: swallow-ok(dump must never mask the original crash)
             pass
         prev_except(tp, val, tb)
 
@@ -199,7 +217,7 @@ def install_crash_hooks(role: str = "") -> None:
                         f"{args.exc_type.__name__}: {args.exc_value}"
                     )
                 )
-        except Exception:
+        except Exception:  # lint: swallow-ok(dump must never mask the original crash)
             pass
         prev_thread(args)
 
@@ -214,7 +232,7 @@ def install_crash_hooks(role: str = "") -> None:
             try:
                 if RECORDER.snapshot():
                     RECORDER.dump(reason=f"signal[{role}]: SIGUSR2")
-            except Exception:
+            except Exception:  # lint: swallow-ok(signal-handler dump is best-effort)
                 pass
             # Chain a pre-existing user handler (e.g. an application's own
             # dump-on-signal); SIG_DFL/SIG_IGN are not callables.

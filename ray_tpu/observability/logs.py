@@ -206,7 +206,7 @@ def _ambient_context() -> Dict[str, Optional[str]]:
         if ctx:
             out["task_id"] = ctx.get("task_id")
             out["actor_id"] = ctx.get("actor_id")
-    except Exception:
+    except Exception:  # lint: swallow-ok(ambient context is optional enrichment)
         pass
     try:
         from .. import tracing
@@ -214,7 +214,7 @@ def _ambient_context() -> Dict[str, Optional[str]]:
         tctx = tracing.current_context()
         if tctx:
             out["trace_id"] = tctx.get("trace_id")
-    except Exception:
+    except Exception:  # lint: swallow-ok(trace context is optional enrichment)
         pass
     return out
 
@@ -235,8 +235,8 @@ class _JsonlHandler(logging.Handler):
                     f"[{rec['level']} {rec['component']}] {rec['msg']}\n"
                 )
                 sys.stderr.flush()
-        except Exception:
-            pass  # logging must never take the process down
+        except Exception:  # lint: swallow-ok(logging must never take the process down)
+            pass
 
 
 def build_record(record: logging.LogRecord) -> Dict[str, Any]:
@@ -377,7 +377,7 @@ def gc_log_dir(
             from ..utils import internal_metrics as imet
 
             imet.LOGS_EVICTED.inc(evicted)
-        except Exception:
+        except Exception:  # lint: swallow-ok(metrics are optional in bare processes)
             pass
     return evicted
 
@@ -503,7 +503,7 @@ def query_cluster(
             recs = RpcClient(n["sock"], connect_timeout=5.0).call(
                 "tail_logs", dict(filters, tail=tail), timeout=30.0
             )
-        except Exception:
+        except Exception:  # lint: swallow-ok(dead node; cluster query merges the live ones)
             continue
         merged.extend(recs or [])
     merged.sort(key=lambda r: r.get("ts") or 0.0)

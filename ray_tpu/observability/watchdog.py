@@ -213,8 +213,8 @@ class Watchdog:
         while not self._stop.wait(self.interval_s):
             try:
                 self.poll_once()
-            except Exception:
-                pass  # one bad tick must not kill the watchdog
+            except Exception:  # lint: swallow-ok(one bad tick must not kill the watchdog; poll_once logs per rule)
+                pass
 
     # -------------------------------------------------------- evaluation
     def _snapshot_buckets(self, rule: Rule, now: float) -> None:
@@ -322,7 +322,7 @@ class Watchdog:
         for rule in self.rules:
             try:
                 value, breached = self._evaluate(rule, now)
-            except Exception:
+            except Exception:  # lint: swallow-ok(malformed rule/missing series; rule skipped this round)
                 continue
             with self._lock:
                 firing = rule.name in self._firing
@@ -358,7 +358,7 @@ class Watchdog:
                     rule.op,
                     rule.threshold,
                 )
-            except Exception:
+            except Exception:  # lint: swallow-ok(alert logging is best-effort; publish below is the contract)
                 pass
             # Dump BEFORE publishing: the alert event carries its dump
             # path, and in-process subscribers may read the published
@@ -370,11 +370,11 @@ class Watchdog:
                         reason=f"watchdog: {rule.name} firing "
                         f"(value={value!r} threshold={rule.threshold})"
                     )
-                except Exception:
+                except Exception:  # lint: swallow-ok(flight dump is best-effort enrichment)
                     pass
             try:
                 self._publish(event)
-            except Exception:
+            except Exception:  # lint: swallow-ok(pubsub down means GCS is down; alert kept in return value)
                 pass
             published.append(event)
         return published

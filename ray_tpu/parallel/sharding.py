@@ -115,6 +115,38 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def replicate_tree(tree: PyTree, mesh: Mesh) -> PyTree:
+    """Places every leaf fully replicated on the mesh.
+
+    Single-process meshes use plain device_put. Multi-process meshes
+    assemble the global array from explicit per-local-device copies
+    (`make_array_from_single_device_arrays`) instead of
+    `device_put(x, replicated)`: the latter routes through jax's
+    `multihost_utils.assert_equal`, which runs one small gloo broadcast
+    PER LEAF and only blocks on device 0's output shard — on a
+    multi-local-device CPU mesh the next leaf's collective can overlap
+    the previous one still posting on the same gloo pair, which aborts
+    the process with `gloo::EnforceNotMet pair.cc:446 op.preamble.length
+    <= op.nbytes` (the tier-1 "gloo reset" flake). Callers pass values
+    that are equal on every process by construction (seed-deterministic
+    init, broadcast weights), so the equality check bought nothing."""
+    sharding = replicated(mesh)
+    n_proc = len({d.process_index for d in mesh.devices.flat})
+    if n_proc <= 1:
+        return jax.device_put(tree, sharding)
+    import numpy as np
+
+    proc = jax.process_index()
+    local = [d for d in mesh.devices.flat if d.process_index == proc]
+
+    def one(leaf):
+        x = np.asarray(leaf)
+        shards = [jax.device_put(x, d) for d in local]
+        return jax.make_array_from_single_device_arrays(x.shape, sharding, shards)
+
+    return jax.tree_util.tree_map(one, tree)
+
+
 def batch_sharding(mesh: Mesh, *, seq: bool = False) -> NamedSharding:
     spec = BATCH_SEQ_SPEC if seq else BATCH_SPEC
     return NamedSharding(mesh, spec)

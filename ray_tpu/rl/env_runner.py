@@ -308,7 +308,14 @@ class EnvRunnerGroup:
                         if r is not first_alive:
                             r.set_connector_state.remote(self._last_connector_state)
             except Exception:
-                pass
+                # Losing connector state (obs normalization stats) after a
+                # runner restart silently skews training — make it loud.
+                from ..observability.logs import get_logger
+
+                get_logger("rl").warning(
+                    "connector-state restore after runner churn failed",
+                    exc_info=True,
+                )
         return out
 
     def connector_state(self):

@@ -55,10 +55,10 @@ class JaxLearner:
             # mesh — required for multi-process SPMD, harmless single-host
             # (init is seed-deterministic, so every process places the same
             # values).
-            from ..parallel.sharding import replicated
+            from ..parallel.sharding import replicate_tree
 
-            self.params = jax.device_put(self.params, replicated(mesh))
-            self.opt_state = jax.device_put(self.opt_state, replicated(mesh))
+            self.params = replicate_tree(self.params, mesh)
+            self.opt_state = replicate_tree(self.opt_state, mesh)
 
         def _update(params, opt_state, batch):
             (loss, metrics), grads = jax.value_and_grad(
@@ -81,6 +81,17 @@ class JaxLearner:
 
             batch = shard_batch(batch, self.mesh)
         self.params, self.opt_state, metrics = self._update(self.params, self.opt_state, batch)
+        if self.mesh is not None and jax.process_count() > 1:
+            # Gloo flake root cause (tier-1 "gloo reset"): float(metrics)
+            # below syncs only the LOSS value; the param/opt-state update's
+            # grad all-reduce may still be in flight when this rank starts
+            # the next step. Gloo pair slots are reused across executions,
+            # so rank A's step-N+1 scalar loss psum (4 bytes) can meet rank
+            # B's step-N grad all-reduce (16+ bytes) on one slot:
+            # `gloo::EnforceNotMet pair.cc:446 op.preamble.length <=
+            # op.nbytes. 16 vs 4`, killing the process. Serialize steps on
+            # the multi-process mesh before returning.
+            jax.block_until_ready((self.params, self.opt_state))
         return {k: float(v) for k, v in metrics.items()}
 
     def get_weights(self) -> PyTree:
@@ -88,9 +99,9 @@ class JaxLearner:
 
     def set_weights(self, params: PyTree) -> bool:
         if self.mesh is not None:
-            from ..parallel.sharding import replicated
+            from ..parallel.sharding import replicate_tree
 
-            params = jax.device_put(params, replicated(self.mesh))
+            params = replicate_tree(params, self.mesh)
         self.params = params
         return True
 
@@ -108,16 +119,16 @@ class JaxLearner:
         if self.mesh is not None:
             # Re-place on the mesh like set_weights: host-local numpy params
             # would hand the jitted update inputs committed to no mesh.
-            from ..parallel.sharding import replicated
+            from ..parallel.sharding import replicate_tree
 
-            params = jax.device_put(params, replicated(self.mesh))
+            params = replicate_tree(params, self.mesh)
         self.params = params
         opt_state = load_aux_state(directory)
         if opt_state is not None:
             if self.mesh is not None:
-                from ..parallel.sharding import replicated
+                from ..parallel.sharding import replicate_tree
 
-                opt_state = jax.device_put(opt_state, replicated(self.mesh))
+                opt_state = replicate_tree(opt_state, self.mesh)
             self.opt_state = opt_state
         else:  # old checkpoint: fresh moments
             self.opt_state = self.tx.init(self.params)
@@ -324,6 +335,6 @@ class LearnerGroup:
             for a in self._actors:
                 try:
                     api.kill(a)
-                except Exception:
+                except Exception:  # lint: swallow-ok(learner actor may already be dead)
                     pass
             self._actors = None

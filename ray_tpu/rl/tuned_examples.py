@@ -135,7 +135,7 @@ def run_regression(name: str, verbose: bool = False) -> Dict[str, Any]:
         if callable(stop):
             try:
                 stop()
-            except Exception:
+            except Exception:  # lint: swallow-ok(best-effort algo stop after the run completed)
                 pass
     return {
         "passed": best >= spec.target_return,

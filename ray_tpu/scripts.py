@@ -123,7 +123,7 @@ def cmd_stop(args) -> None:
     try:
         info = json.load(open(os.path.join(session, "session.json")))
         RpcClient(info["gcs_sock"], connect_timeout=2.0).call("stop", timeout=2.0)
-    except Exception:
+    except Exception:  # lint: swallow-ok(graceful stop is best-effort; SIGKILL sweep follows)
         pass
     time.sleep(0.2)
     killed = 0
@@ -574,7 +574,7 @@ def cmd_logs(args) -> None:
                 if a.get("name") == actor:
                     actor = a["actor_id"]
                     break
-        except Exception:
+        except Exception:  # lint: swallow-ok(name lookup is optional sugar; id prefix still works)
             pass
     filters = {
         "component": args.component,

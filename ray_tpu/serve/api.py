@@ -104,5 +104,5 @@ def shutdown() -> None:
         controller = core_api.get_actor("__serve_controller__")
         core_api.get(controller.shutdown.remote())
         core_api.kill(controller)
-    except Exception:
+    except Exception:  # lint: swallow-ok(no controller running; shutdown is idempotent)
         pass

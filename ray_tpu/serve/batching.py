@@ -255,7 +255,14 @@ def multiplexed(
                         try:
                             unload()
                         except Exception:
-                            pass
+                            # A failed unload hook may leak device memory
+                            # until the replica dies — say which model.
+                            from ..observability.logs import get_logger
+
+                            get_logger("serve").warning(
+                                "__serve_unload__ failed for evicted model %r",
+                                _mid, exc_info=True,
+                            )
             return model
 
         wrapper.__serve_multiplexed__ = True  # type: ignore[attr-defined]

@@ -18,6 +18,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .. import api
 from .. import tracing as _tracing
+from ..observability.logs import get_logger as _get_logger
+from ..utils import lock_order
+
+_log = _get_logger("serve")
 
 CONTROLLER_NAME = "__serve_controller__"
 
@@ -317,7 +321,7 @@ class ServeController:
         self._replicas: Dict[str, List[Any]] = {}  # app -> replica handles
         self._app_gen: Dict[str, int] = {}  # bumped on deploy/delete
         self._version = 0
-        self._lock = threading.Lock()
+        self._lock = lock_order.tracked_lock("serve.controller")
         self._stop = threading.Event()
         # Preemption awareness: subscribe to node_draining notices so
         # replicas on a departing node are REPLACED (and de-routed)
@@ -378,7 +382,7 @@ class ServeController:
         for r in old_replicas:
             try:
                 api.kill(r)
-            except Exception:
+            except Exception:  # lint: swallow-ok(replica may already be dead)
                 pass
         # Composition children the new bind no longer references would
         # otherwise leak their replica actors until controller shutdown.
@@ -397,7 +401,7 @@ class ServeController:
         for r in replicas:
             try:
                 api.kill(r)
-            except Exception:
+            except Exception:  # lint: swallow-ok(replica may already be dead)
                 pass
         # Cascade to composition-created inner apps: deleting only the
         # outer app would leak their replica actors.
@@ -424,7 +428,7 @@ class ServeController:
             time.sleep(0.25)
         try:
             api.kill(replica)
-        except Exception:
+        except Exception:  # lint: swallow-ok(replica may already be dead)
             pass
 
     # ---------------------------------------------------------- reconcile
@@ -468,7 +472,7 @@ class ServeController:
                 for r in created + victims:
                     try:
                         api.kill(r)
-                    except Exception:
+                    except Exception:  # lint: swallow-ok(outdated replica may already be dead)
                         pass
                 continue
             # Graceful drain (reference: deployment_state graceful
@@ -488,7 +492,9 @@ class ServeController:
                 self._autoscale()
                 self._reconcile()
             except Exception:
-                pass
+                # One bad tick must not kill the loop, but a silently
+                # failing controller is how serve apps rot: say what broke.
+                _log.warning("serve control-loop tick failed", exc_info=True)
 
     # ---------------------------------------------------- preemption drain
     def _kick_drain_replacement(self) -> None:
@@ -574,7 +580,7 @@ class ServeController:
                 for r in replacements:
                     try:
                         api.kill(r)
-                    except Exception:
+                    except Exception:  # lint: swallow-ok(unhealthy replacement may already be dead)
                         pass
                 handled_any = False  # no capacity yet: retry next tick
                 continue
@@ -605,7 +611,7 @@ class ServeController:
                 for r in replacements:
                     try:
                         api.kill(r)
-                    except Exception:
+                    except Exception:  # lint: swallow-ok(stale replacement may already be dead)
                         pass
                 continue
             # Old replicas finish their in-flight work, then die.
@@ -632,7 +638,7 @@ class ServeController:
                 continue
             try:
                 loads = api.get([r.queue_len.remote() for r in replicas], timeout=2)
-            except Exception:
+            except Exception:  # lint: swallow-ok(replica busy or dying; autoscale skips the round)
                 continue
             total = sum(loads)
             per = total / max(1, len(replicas))

@@ -71,6 +71,22 @@ def setup_jax_distributed(
         os.environ["XLA_FLAGS"] = (
             f"{flags} --xla_force_host_platform_device_count={devices_per_worker}".strip()
         )
+    resolved_platform = platform or os.environ.get("RAY_TPU_PLATFORM")
+    if world_size > 1 and resolved_platform == "cpu":
+        # Deflake (tier-1 "gloo reset"): the CPU thunk runtime executes
+        # independent collective thunks CONCURRENTLY, and two in-flight
+        # all-reduces of different sizes on one gloo context collide on a
+        # pair slot — `gloo::EnforceNotMet pair.cc:446 op.preamble.length
+        # <= op.nbytes. 16 vs 4` aborts the process (~1-in-3 repro on the
+        # 2-learner gang). The legacy executor runs thunks sequentially,
+        # which serializes same-context collectives. Must be set before
+        # this process's first backend init (this call is the actor's
+        # first jax-touching code).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_cpu_use_thunk_runtime" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_cpu_use_thunk_runtime=false".strip()
+            )
 
     import jax
 
@@ -79,11 +95,16 @@ def setup_jax_distributed(
         # reliable override for processes where jax is already imported.
         jax.config.update("jax_platforms", platform)
         os.environ["RAY_TPU_PLATFORM"] = platform
-    if platform == "cpu" and world_size > 1:
+    if resolved_platform == "cpu" and world_size > 1:
         # Cross-process collectives on the host platform go through gloo
         # (the emulation analogue of ICI; the reference's CPU fallback is
         # GLOOGroup, gloo_collective_group.py:184).
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        # Deflake, part 2 (same root cause as the thunk-runtime flag
+        # above): async dispatch lets a later program's gloo op go in
+        # flight while an earlier one is still posting on the same pair,
+        # and the two processes need not interleave identically.
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
 
     if world_size > 1:
         jax.distributed.initialize(

@@ -56,7 +56,7 @@ def _as_numpy(leaf: Any) -> np.ndarray:
         import jax
 
         leaf = jax.device_get(leaf)
-    except Exception:
+    except Exception:  # lint: swallow-ok(jax absent or host leaf; np.asarray below handles it)
         pass
     return np.asarray(leaf)
 

@@ -477,7 +477,7 @@ class JaxTrainer:
                         for w in group.workers:
                             try:
                                 w.request_drain.remote()
-                            except Exception:
+                            except Exception:  # lint: swallow-ok(worker already dead; drain moot)
                                 pass
                 # Bounded rounds (in cluster mode): a worker mid-step in a
                 # long compute answers with the __pending__ sentinel after

@@ -259,7 +259,7 @@ class _TuneController:
                 # Unblock the training thread (it unwinds with TrialAborted
                 # at its next report) before tearing the actor down.
                 api.get(tracked.handle.stop_training.remote())
-            except Exception:
+            except Exception:  # lint: swallow-ok(trial actor may already be dead; removed below)
                 pass
             self._mgr.remove_actor(tracked, kill=True)
         trial.status = status

@@ -356,8 +356,8 @@ def _flush_once() -> None:
     for m in metrics:
         try:
             records.extend(m._collect(node))
-        except Exception:
-            pass  # one broken metric must not kill the flusher
+        except Exception:  # lint: swallow-ok(one broken metric must not kill the flusher)
+            pass
     if not records:
         return
     if sink is None:
@@ -454,6 +454,15 @@ GCS_SYNC_BATCH = Histogram(
     "Records per raylet->GCS sync batch",
     component="scheduler",
     boundaries=[1, 2, 5, 10, 25, 50, 100, 250, 1000],
+)
+# --- lock-order detector (utils/lock_order.py) ----------------------------
+LOCK_ORDER_VIOLATIONS = Counter(
+    "raytpu_lock_order_violations_total",
+    "Lock-order hazards seen by the dynamic detector (RAY_TPU_LOCK_ORDER=1), "
+    "by kind: cycle (AB/BA inversion), self (non-reentrant re-acquire), "
+    "long_hold (critical section past the hold threshold)",
+    component="runtime",
+    tag_keys=("kind",),
 )
 # --- GCS ------------------------------------------------------------------
 GCS_RPC_TOTAL = Counter(
@@ -764,7 +773,7 @@ class ReporterAgent:
         while not self._stop.wait(self.interval_s):
             try:
                 self.collect_once()
-            except Exception:
+            except Exception:  # lint: swallow-ok(one bad sample round; reporter retries next tick)
                 pass
 
     # ------------------------------------------------------------ readers

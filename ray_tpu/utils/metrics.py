@@ -204,8 +204,8 @@ def _flush_once() -> None:
     for m in metrics:
         try:
             records.extend(m._collect())
-        except Exception:
-            pass  # one broken metric must not kill the process flusher
+        except Exception:  # lint: swallow-ok(one broken metric must not kill the process flusher)
+            pass
     if records:
         try:
             gcs.call("report_metrics", getattr(rt, "_worker_id", "?"), records)

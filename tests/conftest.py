@@ -20,6 +20,12 @@ os.environ["RAY_TPU_PLATFORM"] = "cpu"
 # Worker processes pin jax to CPU too (worker_proc.main reads this): the
 # suite must be hermetic against TPU-tunnel outages.
 os.environ["RAY_TPU_JAX_PLATFORMS"] = "cpu"
+# Arm the dynamic lock-order detector for every runtime process the suite
+# boots (raylet/GCS/serve-controller daemons inherit the env): an AB/BA
+# inversion or >1s hold anywhere in tier-1 lands in the flight recorder
+# and raytpu_lock_order_violations_total instead of staying a latent
+# deadlock. Disarmed processes pay nothing (plain threading.Lock).
+os.environ.setdefault("RAY_TPU_LOCK_ORDER", "1")
 
 import pytest
 

@@ -1,0 +1,352 @@
+"""graft-lint framework + analyzer tests, and the tier-1 CI gate.
+
+Per-analyzer fixture snippets (positive + suppressed), baseline
+round-trip, metric-catalog self-check against the live tree, and the
+canary-style gate: `python -m tools.lint --baseline tools/lint/baseline.json`
+must exit 0 against the tree, exactly as CI runs it.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.lint.framework import (  # noqa: E402
+    FileContext,
+    load_baseline,
+    registered,
+    run_lint,
+    save_baseline,
+)
+from tools.lint.rules.blocking_in_loop import BlockingInLoop  # noqa: E402
+from tools.lint.rules.lock_discipline import LockDiscipline  # noqa: E402
+from tools.lint.rules.metric_catalog import MetricCatalog  # noqa: E402
+from tools.lint.rules.no_print import NoPrint  # noqa: E402
+from tools.lint.rules.silent_swallow import SilentSwallow  # noqa: E402
+from tools.lint.rules.typed_raise import TypedRaise  # noqa: E402
+
+
+def _ctx(text: str, relpath: str = "ray_tpu/fake_module.py") -> FileContext:
+    """A FileContext for fixture source under a chosen repo-relative path
+    (no file is written; path only steers path-sensitive rules)."""
+    return FileContext(os.path.join(REPO_ROOT, relpath), textwrap.dedent(text))
+
+
+def _findings(analyzer, ctx):
+    return [f for f in analyzer.check_file(ctx) if not ctx.suppressed(f.rule, f.line)]
+
+
+# ------------------------------------------------------------ registry
+def test_registry_has_expected_rules():
+    rules = registered()
+    expected = {
+        "silent-swallow", "blocking-in-loop", "metric-catalog",
+        "typed-raise", "lock-discipline", "no-print", "import-safety",
+    }
+    assert expected <= set(rules)
+    fast_default = [n for n, c in rules.items() if c.default_enabled and not c.slow]
+    assert len(fast_default) >= 5  # acceptance: >=5 analyzers active
+
+
+# ------------------------------------------------------- silent-swallow
+def test_silent_swallow_positive_and_suppressed():
+    bad = _ctx("""
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """)
+    assert len(_findings(SilentSwallow(), bad)) == 1
+
+    marked = _ctx("""
+        def f():
+            try:
+                g()
+            except Exception:  # lint: swallow-ok(best-effort cleanup)
+                pass
+    """)
+    assert _findings(SilentSwallow(), marked) == []
+
+    logged = _ctx("""
+        def f():
+            try:
+                g()
+            except Exception:
+                log.warning("g failed")
+    """)
+    assert _findings(SilentSwallow(), logged) == []
+
+    narrow = _ctx("""
+        def f():
+            try:
+                g()
+            except OSError:
+                pass
+    """)
+    assert _findings(SilentSwallow(), narrow) == []
+
+    cont = _ctx("""
+        def f(items):
+            for i in items:
+                try:
+                    g(i)
+                except Exception:
+                    continue
+    """)
+    assert len(_findings(SilentSwallow(), cont)) == 1
+
+    disabled = _ctx("""
+        def f():
+            try:
+                g()
+            except Exception:  # lint: disable=silent-swallow
+                pass
+    """)
+    assert _findings(SilentSwallow(), disabled) == []
+
+
+# ----------------------------------------------------- blocking-in-loop
+def test_blocking_under_lock_flagged():
+    bad = _ctx("""
+        import time
+        def f(self):
+            with self._lock:
+                time.sleep(1.0)
+    """)
+    got = _findings(BlockingInLoop(), bad)
+    assert len(got) == 1 and "holding" in got[0].message
+
+    ok = _ctx("""
+        import time
+        def f(self):
+            with self._lock:
+                x = 1
+            time.sleep(1.0)
+    """)
+    assert _findings(BlockingInLoop(), ok) == []
+
+    cv_wait = _ctx("""
+        def f(self):
+            with self._seal_cv:
+                self._seal_cv.wait(1.0)
+    """)
+    assert _findings(BlockingInLoop(), cv_wait) == []
+
+
+def test_sleep_in_tick_function_flagged_only_in_tick_files():
+    src = """
+        import time
+        class S:
+            def _monitor_loop(self):
+                while not self._stop.is_set():
+                    time.sleep(0.5)
+    """
+    tick = _ctx(src, "ray_tpu/core/raylet.py")
+    assert len(_findings(BlockingInLoop(), tick)) == 1
+    other = _ctx(src, "ray_tpu/data/dataset.py")
+    assert _findings(BlockingInLoop(), other) == []
+
+
+# ------------------------------------------------------ lock-discipline
+def test_lock_discipline_bare_acquire_and_double_acquire():
+    bare = _ctx("""
+        def f(self):
+            self._lock.acquire()
+            work()
+            self._lock.release()
+    """)
+    got = _findings(LockDiscipline(), bare)
+    assert len(got) == 1 and "bare" in got[0].message
+
+    double = _ctx("""
+        def f(self):
+            with self._lock:
+                with self._lock:
+                    pass
+    """)
+    got = _findings(LockDiscipline(), double)
+    assert len(got) == 1 and "double acquire" in got[0].message
+
+    rlock_ok = _ctx("""
+        def f(self):
+            with self._rlock:
+                with self._rlock:
+                    pass
+    """)
+    assert _findings(LockDiscipline(), rlock_ok) == []
+
+    different_fns = _ctx("""
+        def f(self):
+            with self._lock:
+                pass
+        def g(self):
+            with self._lock:
+                pass
+    """)
+    assert _findings(LockDiscipline(), different_fns) == []
+
+    with_ok = _ctx("""
+        def f(self):
+            with self._lock:
+                pass
+    """)
+    assert _findings(LockDiscipline(), with_ok) == []
+
+
+# ----------------------------------------------------------- typed-raise
+_FAKE_EXCEPTIONS = """
+class RayTpuError(Exception):
+    pass
+class PlacementGroupError(RayTpuError, RuntimeError):
+    pass
+"""
+
+
+def test_typed_raise_in_rpc_service():
+    svc = _ctx("""
+        class GcsService:
+            def create_thing(self):
+                raise RuntimeError("untyped")
+            def fine(self):
+                raise PlacementGroupError("typed")
+            def _private(self):
+                raise RuntimeError("not an RPC surface")
+            def reraise(self, e):
+                raise
+        class NotAService:
+            def create_thing(self):
+                raise RuntimeError("not flagged")
+    """, "ray_tpu/core/fake_gcs.py")
+    exc_ctx = _ctx(_FAKE_EXCEPTIONS, "ray_tpu/exceptions.py")
+    got = list(TypedRaise().check_tree([svc, exc_ctx]))
+    assert len(got) == 1
+    assert "create_thing" in got[0].message and got[0].line == 4
+
+
+# -------------------------------------------------------------- no-print
+def test_no_print_rule():
+    bad = _ctx("def f():\n    print('hi')\n")
+    assert len(_findings(NoPrint(), bad)) == 1
+    marked = _ctx("def f():\n    print('hi')  # console-output: banner\n")
+    assert _findings(NoPrint(), marked) == []
+    cli = _ctx("def f():\n    print('hi')\n", "ray_tpu/scripts.py")
+    assert _findings(NoPrint(), cli) == []
+    outside = _ctx("def f():\n    print('hi')\n", "tools/whatever.py")
+    assert _findings(NoPrint(), outside) == []
+
+
+# --------------------------------------------------------- metric-catalog
+def test_metric_catalog_self_check_live_tree():
+    """The live tree's metric names, chaos points, and flight-recorder
+    kind prefixes must round-trip with their catalogs."""
+    run = run_lint(paths=("ray_tpu",), rules=("metric-catalog",))
+    assert run.findings == [], [f.render() for f in run.findings]
+
+
+def test_metric_catalog_flags_undeclared_names():
+    cat = MetricCatalog()
+    metrics = _ctx("""
+        class Counter:
+            def __init__(self, *a, **k): pass
+        DECLARED = Counter("raytpu_declared_total", "x")
+    """, "ray_tpu/utils/internal_metrics.py")
+    user = _ctx("""
+        NAME = "raytpu_not_declared_total"
+        USED = "raytpu_declared_total"
+        import DECLARED
+    """, "ray_tpu/fake_user.py")
+    got = list(cat.check_tree([metrics, user]))
+    assert len(got) == 1 and "raytpu_not_declared_total" in got[0].message
+
+    # Reverse direction: declared but never recorded.
+    lonely = _ctx("""
+        class Counter:
+            def __init__(self, *a, **k): pass
+        DEAD = Counter("raytpu_dead_metric_total", "x")
+    """, "ray_tpu/utils/internal_metrics.py")
+    got = list(cat.check_tree([lonely]))
+    assert len(got) == 1 and "never recorded" in got[0].message
+
+
+# ---------------------------------------------------- baseline round-trip
+def test_baseline_round_trip(tmp_path):
+    pkg = tmp_path / "ray_tpu_fixture"
+    pkg.mkdir()
+    f = pkg / "mod.py"
+    f.write_text(textwrap.dedent("""
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """))
+    run1 = run_lint(paths=(str(pkg),), rules=("silent-swallow",))
+    assert len(run1.findings) == 1 and len(run1.new) == 1
+
+    bpath = str(tmp_path / "baseline.json")
+    save_baseline(bpath, run1.findings)
+    run2 = run_lint(paths=(str(pkg),), rules=("silent-swallow",),
+                    baseline=load_baseline(bpath))
+    assert run2.new == [] and len(run2.baselined) == 1
+
+    # New debt is NOT absorbed by the old baseline...
+    f.write_text(f.read_text() + textwrap.dedent("""
+        def h():
+            try:
+                g()
+            except Exception:
+                pass
+    """))
+    run3 = run_lint(paths=(str(pkg),), rules=("silent-swallow",),
+                    baseline=load_baseline(bpath))
+    assert len(run3.new) == 1 and len(run3.baselined) == 1
+
+    # ...and fixed debt shows up as stale budget.
+    f.write_text("def f():\n    pass\n")
+    run4 = run_lint(paths=(str(pkg),), rules=("silent-swallow",),
+                    baseline=load_baseline(bpath))
+    assert run4.findings == [] and sum(run4.stale_baseline.values()) == 1
+
+
+# ----------------------------------------------------------- CI gate
+def test_lint_gate_tree_is_clean():
+    """Tier-1 gate (canary-style, like test_import_safety): the linter
+    must pass against the tree with the committed baseline. Slow rules
+    are skipped here because test_import_safety runs that canary
+    directly in this same suite."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint",
+         "--baseline", os.path.join("tools", "lint", "baseline.json"),
+         "--skip-slow"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_lint_cli_json_and_list_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--list-rules"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+    assert "silent-swallow" in proc.stdout and "import-safety" in proc.stdout
+
+    import json
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--skip-slow", "--json",
+         "--baseline", os.path.join("tools", "lint", "baseline.json")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["ok"] is True and data["new"] == []

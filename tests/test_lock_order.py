@@ -1,0 +1,177 @@
+"""Dynamic lock-order detector tests (ray_tpu/utils/lock_order.py).
+
+Seeded AB/BA inversion detected and reported via flight recorder +
+raytpu_lock_order_violations_total; no false positives on reentrant or
+consistently-ordered usage; disarmed factories return plain stdlib locks
+(zero overhead); the raylet/GCS/serve-controller boot paths create
+tracked locks when armed.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu.utils import lock_order as lo
+
+
+@pytest.fixture(autouse=True)
+def _fresh_detector(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LOCK_ORDER", "1")
+    lo.reset()
+    yield
+    lo.reset()
+
+
+def test_ab_ba_inversion_detected():
+    a, b = lo.tracked_lock("test.A"), lo.tracked_lock("test.B")
+    with a:
+        with b:
+            pass
+
+    def inverted():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join(timeout=10)
+    kinds = [v["kind"] for v in lo.violations()]
+    assert "cycle" in kinds
+    v = next(v for v in lo.violations() if v["kind"] == "cycle")
+    assert v["acquiring"] == "test.A" and v["while_holding"] == "test.B"
+    assert "test.A->test.B" in v["established_order"]
+
+
+def test_inversion_reports_flight_and_metric():
+    from ray_tpu.observability.flight_recorder import RECORDER
+    from ray_tpu.utils import internal_metrics as imet
+
+    bound = imet.LOCK_ORDER_VIOLATIONS.labels(kind="cycle")
+    before = sum(c[0] for _t, c in bound._cells)
+    a, b = lo.tracked_lock("test.FA"), lo.tracked_lock("test.FB")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # same thread: still a proven inversion in the graph
+            pass
+    assert any(v["kind"] == "cycle" for v in lo.violations())
+    kinds = [e[1] for e in RECORDER.snapshot()]
+    assert "lock.order_cycle" in kinds
+    after = sum(c[0] for _t, c in bound._cells)
+    assert after == before + 1
+
+
+def test_no_false_positive_on_consistent_order_and_reentrancy():
+    x, y = lo.tracked_lock("test.X"), lo.tracked_lock("test.Y")
+    for _ in range(5):
+        with x:
+            with y:
+                pass
+    r = lo.tracked_rlock("test.R")
+    with r:
+        with r:  # reentrant: no self/cycle violation
+            with x:
+                pass
+    assert lo.violations() == []
+
+
+def test_inversion_deduplicated_per_signature():
+    a, b = lo.tracked_lock("test.DA"), lo.tracked_lock("test.DB")
+    with a:
+        with b:
+            pass
+    for _ in range(3):
+        with b:
+            with a:
+                pass
+    assert len([v for v in lo.violations() if v["kind"] == "cycle"]) == 1
+
+
+def test_self_deadlock_reported_before_blocking():
+    s = lo.tracked_lock("test.S")
+
+    def doomed():
+        s.acquire()
+        s.acquire()  # blocks forever — but only AFTER reporting
+
+    t = threading.Thread(target=doomed, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if any(v["kind"] == "self" for v in lo.violations()):
+            break
+        time.sleep(0.02)
+    assert any(v["kind"] == "self" for v in lo.violations())
+
+
+def test_long_hold_reported(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LOCK_ORDER_HOLD_S", "0.05")
+    h = lo.tracked_lock("test.H")
+    with h:
+        time.sleep(0.08)
+    v = [v for v in lo.violations() if v["kind"] == "long_hold"]
+    assert len(v) == 1 and v[0]["lock"] == "test.H" and v[0]["held_s"] >= 0.05
+
+
+def test_timeout_and_nonblocking_acquire_paths():
+    s = lo.tracked_lock("test.T")
+    assert s.acquire(timeout=0.1)
+    assert s.locked()
+    s.release()
+    assert s.acquire(blocking=False)
+    s.release()
+    assert lo.violations() == []
+
+
+def test_disarmed_factories_return_plain_stdlib_locks(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_LOCK_ORDER", raising=False)
+    plain = lo.tracked_lock("test.plain")
+    assert type(plain) is type(threading.Lock())
+    rplain = lo.tracked_rlock("test.rplain")
+    assert type(rplain) is type(threading.RLock())
+
+
+def test_condition_protocol_compat():
+    """threading.Condition accepts a tracked lock (wait/notify release and
+    re-acquire through the wrapper)."""
+    l = lo.tracked_lock("test.CV")
+    cv = threading.Condition(l)
+    hits = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            hits.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    with cv:
+        cv.notify()
+    t.join(timeout=5)
+    assert hits == [1]
+    assert not [v for v in lo.violations() if v["kind"] != "long_hold"]
+
+
+def test_control_plane_boot_paths_create_tracked_locks():
+    """The raylet/GCS/serve-controller boot paths route their locks
+    through the armed factory (the tier-1 conftest arms the env, so the
+    whole suite's daemons run instrumented)."""
+    from ray_tpu.core.gcs import GcsService
+
+    svc = GcsService()
+    try:
+        assert isinstance(svc._lock, lo.TrackedRLock)
+        assert svc._lock.name == "gcs.state"
+    finally:
+        svc._stop.set()
+
+    from ray_tpu.serve.controller import ServeController
+
+    src = ServeController.__init__.__code__.co_consts  # cheap static probe
+    # Instantiating the controller needs a runtime; assert the wiring at
+    # source level instead: the name literal rides the code object.
+    assert "serve.controller" in src

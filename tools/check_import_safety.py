@@ -76,6 +76,10 @@ REQUIRED = {
     "ray_tpu.train.elastic_checkpoint",
     "ray_tpu.train.zero",
     "ray_tpu.cgraph.elastic",
+    # The lock-order detector imports into the raylet, GCS, serve
+    # controller, and driver at module load; a backend init here would
+    # wedge every control plane at boot.
+    "ray_tpu.utils.lock_order",
 }
 
 

@@ -1,21 +1,14 @@
 """No-print lint: runtime code must log through the structured logger.
 
+Now a thin wrapper over the graft-lint framework's `no-print` rule
+(tools/lint/rules/no_print.py) — one AST-based implementation, two entry
+points (`python tools/check_no_print.py` keeps its CI/exit-code contract;
+`python -m tools.lint` runs it alongside every other rule).
+
 Bare `print(...)` in `ray_tpu/` vanishes when the process dies, carries
-no node/worker/task attribution, and bypasses the capture/dedup path —
-the class of debugging dead-end the structured logging subsystem
-(ray_tpu/observability/logs.py) exists to end. This check fails on any
-`print(` call in the package, with two escape hatches:
-
-- `ray_tpu/scripts.py` is the CLI: its prints ARE the user-facing
-  output (whole file allowed).
-- a line (or call head) marked `# console-output: <why>` is deliberate
-  console IO — bootstrap protocol announcements the parent process
-  parses (GCS_TCP_ADDRESS=), the driver's attributed re-print of
-  captured worker output, explicit verbose-mode progress.
-
-Run directly (CI) or through tests/test_logs.py:
-
-    python tools/check_no_print.py
+no node/worker/task attribution, and bypasses the capture/dedup path.
+Escape hatches: `ray_tpu/scripts.py` (the CLI; its prints ARE the user
+output) and lines marked `# console-output: <why>`.
 """
 
 from __future__ import annotations
@@ -25,15 +18,14 @@ import re
 import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(_REPO_ROOT, "ray_tpu")
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
-ALLOWED_FILES = {
-    os.path.join("ray_tpu", "scripts.py"),
-}
 MARKER = "console-output"
 
-# A real call: `print(` preceded by start-of-line/whitespace/punctuation —
-# not `pprint(`, not a string mentioning "print(".
+# Kept for self-tests and as documentation of the line-level heuristic the
+# AST rule replaces: a real call is `print(` preceded by start-of-line/
+# whitespace/punctuation — not `pprint(`, not a string mentioning it.
 _PRINT_RE = re.compile(r"(?:^|[\s(\[{:;,=])print\(")
 
 
@@ -47,43 +39,18 @@ def _line_flagged(line: str, prev: str) -> bool:
 
 
 def check() -> int:
-    violations = []
-    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
-        if "__pycache__" in dirpath:
-            continue
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, _REPO_ROOT)
-            if rel in ALLOWED_FILES:
-                continue
-            try:
-                with open(path, encoding="utf-8", errors="replace") as f:
-                    lines = f.readlines()
-            except OSError:
-                continue
-            prev = ""
-            in_string = False
-            for i, line in enumerate(lines, 1):
-                # Cheap triple-quote tracking: lines inside docstrings are
-                # prose, not calls.
-                quotes = line.count('"""') + line.count("'''")
-                if in_string:
-                    if quotes % 2 == 1:
-                        in_string = False
-                    prev = line
-                    continue
-                if quotes % 2 == 1:
-                    in_string = True
-                if _line_flagged(line, prev):
-                    violations.append(f"{rel}:{i}: {line.strip()}")
-                prev = line
-    if violations:
+    from tools.lint.framework import run_lint
+
+    run = run_lint(paths=("ray_tpu",), rules=("no-print",))
+    if run.errors:
+        for e in run.errors:
+            print(f"error: {e}")
+        return 2
+    if run.findings:
         print("bare print() in runtime code (use observability.logs.get_logger,")
         print(f"or mark deliberate console IO with `# {MARKER}: <why>`):")
-        for v in violations:
-            print(f"  {v}")
+        for f in run.findings:
+            print(f"  {f.render()}")
         return 1
     print("no-print lint OK")
     return 0

@@ -1,0 +1,30 @@
+"""graft-lint: project-specific static analysis for the ray_tpu runtime.
+
+An AST-based analyzer framework with a plugin registry (spirit of the
+reference's ci/lint + pre-push gates, specialized to THIS runtime's
+invariants: no silent exception swallows in control loops, no blocking
+calls under locks, metric/chaos-point catalogs closed, typed raises at
+RPC boundaries, lock discipline). One entry point:
+
+    python -m tools.lint                       # whole tree, baseline applied
+    python -m tools.lint --list-rules
+    python -m tools.lint path/to/file.py --no-baseline
+
+Findings are machine-readable (`path:line: rule-id: message`, or --json),
+suppressible per line with `# lint: disable=<rule>` (same line or the
+line above), and pre-existing debt lives in tools/lint/baseline.json so
+new violations block while old ones are tracked down to zero.
+"""
+
+from .framework import (  # noqa: F401
+    Analyzer,
+    FileContext,
+    Finding,
+    LintRun,
+    load_baseline,
+    registered,
+    register,
+)
+
+# Importing the rules package populates the registry.
+from . import rules  # noqa: F401  E402

@@ -1,0 +1,11 @@
+"""graft-lint rule plugins. Importing this package registers every rule."""
+
+from . import (  # noqa: F401
+    blocking_in_loop,
+    import_safety,
+    lock_discipline,
+    metric_catalog,
+    no_print,
+    silent_swallow,
+    typed_raise,
+)
