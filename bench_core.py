@@ -589,6 +589,87 @@ def bench_chaos_overhead_guard(min_time: float) -> None:
     )
 
 
+def bench_rpc_chaos_overhead_guard(min_time: float) -> None:
+    """net.* rpc injection-point overhead guard.
+
+    The partition PR threads chaos gates into RpcClient.call/notify/
+    _new_sock — the entire control plane pays them on every message.
+    Disarmed (no controller, no partition spec) the gate is two global
+    loads + None checks; this guard µbenches that exact call and pins
+    the per-task-dispatch fraction under the ISSUE's 1% budget, plus an
+    end-to-end sanity run with an armed-but-never-matching net rule."""
+    import os
+
+    from ray_tpu import chaos
+    from ray_tpu.core import rpc as rpc_mod
+
+    chaos.disable()
+    assert not rpc_mod._net_chaos_armed()
+    n_calls = 500_000
+    t0 = time.perf_counter()
+    for _ in range(n_calls):
+        rpc_mod._net_chaos_armed()
+    gate_ns = (time.perf_counter() - t0) / n_calls * 1e9
+
+    # End-to-end: dispatch rate with a never-matching net.call rule armed
+    # cluster-wide vs off (same interleaved-boot recipe as the chaos
+    # guard — daemons read RAY_TPU_CHAOS from their spawn env).
+    never_matching = (
+        '[{"point": "net.call", "action": "raise", '
+        '"match": "__net_bench_never__", "times": -1}]'
+    )
+    saved = os.environ.get("RAY_TPU_CHAOS")
+    rates = {}
+    try:
+        for label, env in (("off", None), ("armed", never_matching)):
+            if env is None:
+                os.environ.pop("RAY_TPU_CHAOS", None)
+                chaos.disable()
+            else:
+                os.environ["RAY_TPU_CHAOS"] = env
+                chaos.configure(env)
+            rt.init(num_cpus=8, num_workers=2, object_store_memory=256 << 20)
+            rates[label] = _sync_dispatch_rate(min_time)
+            rt.shutdown()
+    finally:
+        if saved is None:
+            os.environ.pop("RAY_TPU_CHAOS", None)
+        else:
+            os.environ["RAY_TPU_CHAOS"] = saved
+        chaos.disable()
+
+    # A task dispatch crosses a handful of RpcClient messages end to end
+    # (submit notify + wait_objects + heartbeat-amortized control calls);
+    # 6 is a conservative ceiling.
+    gates_per_task = 6
+    disarmed_fraction = gates_per_task * gate_ns * 1e-9 * rates["off"]
+    armed_ratio = rates["armed"] / rates["off"] if rates["off"] else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "rpc_chaos_overhead",
+                "value": round(disarmed_fraction, 5),
+                "unit": "fraction of task dispatch (disarmed net gates, est.)",
+                "vs_baseline": None,
+                "disarmed_ns_per_gate": round(gate_ns, 1),
+                "armed_ratio": round(armed_ratio, 3),
+                "off_ops_s": round(rates["off"], 1),
+                "armed_ops_s": round(rates["armed"], 1),
+            }
+        ),
+        flush=True,
+    )
+    assert disarmed_fraction < 0.01, (
+        f"disarmed net.* rpc gates cost {100 * disarmed_fraction:.2f}% of "
+        f"task dispatch (budget: 1%) — {gate_ns:.0f} ns/gate at "
+        f"{rates['off']:.0f} tasks/s"
+    )
+    assert armed_ratio >= 0.90, (
+        f"armed (non-matching) net rules cost {100 * (1 - armed_ratio):.1f}% "
+        f"of task dispatch (sanity budget: 10%) — {rates}"
+    )
+
+
 def _store_puts_total() -> float:
     """Cluster-aggregated raytpu_store_puts_total (all processes)."""
     from ray_tpu.utils import state
@@ -984,6 +1065,7 @@ def main():
     bench_overhead_guard(min_time)
     bench_tracing_overhead_guard(min_time)
     bench_chaos_overhead_guard(min_time)
+    bench_rpc_chaos_overhead_guard(min_time)
     bench_history_watchdog_overhead_guard(min_time)
     bench_logging_overhead_guard(min_time)
     bench_lock_order_overhead_guard(min_time)

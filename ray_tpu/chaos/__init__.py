@@ -13,9 +13,16 @@ or programmatically in one process::
     chaos.configure([chaos.ChaosRule(point="chan.write", action="delay",
                                      delay_s=0.2, times=-1)])
 
+Network partitions are first-class (:mod:`ray_tpu.chaos.net`)::
+
+    p = chaos.partition([[node_id], ["gcs"]], heal_after=8.0)
+    ...
+    p.heal()
+
 See :mod:`ray_tpu.chaos.controller` for the rule schema and the list of
 injection points, and the README's "Fault tolerance & chaos testing"
-section for the fault model.
+section for the fault model, the membership state machine, and the
+partition API.
 """
 
 from .controller import (  # noqa: F401
@@ -31,3 +38,4 @@ from .controller import (  # noqa: F401
     kill_now,
     maybe_inject,
 )
+from .net import Partition, partition  # noqa: F401

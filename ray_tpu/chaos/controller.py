@@ -77,6 +77,14 @@ POINT_ACTIONS = {
     "coll.rendezvous": ("raise",),            # collective.py group setup
     "coll.op": ("raise", "delay"),            # collective.py each op
     "provider.poll": ("preempt",),            # node provider poll round
+    # Control-plane network faults (core/rpc.py). `drop` on net.call
+    # black-holes the message (one-way sends vanish; two-way calls fail
+    # like a vanished peer); `drop` on net.connect makes the connect
+    # loop burn its own retry deadline, exactly like packets on the
+    # floor. Group-based partitions (chaos.partition) ride the same
+    # sites via chaos/net.py.
+    "net.call": ("drop", "delay", "raise"),   # RpcClient.call/notify, by addr|method
+    "net.connect": ("drop", "raise"),         # RpcClient._new_sock, by addr
 }
 POINTS = tuple(POINT_ACTIONS)
 

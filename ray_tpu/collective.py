@@ -54,6 +54,23 @@ def _rendezvous_timeout() -> float:
         return 60.0
 
 
+def _op_timeout() -> float:
+    """Mid-op deadline for ring sends/recvs. Deliberately MUCH larger
+    than the rendezvous deadline: a rank blocked in recv is usually
+    waiting for a healthy straggler to ENTER the op (long compile,
+    checkpoint write), and killing the gang at rendezvous speed would
+    turn every slow step into a spurious CollectiveTimeoutError."""
+    import os
+
+    try:
+        explicit = float(
+            os.environ.get("RAY_TPU_COLLECTIVE_OP_TIMEOUT_S", "") or 0.0
+        )
+    except ValueError:
+        explicit = 0.0
+    return explicit if explicit > 0 else max(5.0 * _rendezvous_timeout(), 300.0)
+
+
 def _send_msg(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
@@ -327,11 +344,41 @@ class _Group:
         self._prev = accepted["conn"]
 
     # ------------------------------------------------------------ primitives
+    def _fail_op(self, what: str, peer: int) -> None:
+        """A ring send/recv exceeded the op deadline: the peer is
+        stalled, dead, or partitioned away mid-op. Surface the same
+        typed, rank-naming error a failed rendezvous produces — a bare
+        hang (the old behavior: blocking recv with no timeout) leaves a
+        gang wedged with nothing to post-mortem."""
+        _flight_record("coll.timeout", (self.name, self.rank, (peer,)))
+        raise CollectiveTimeoutError(
+            self.name,
+            self.rank,
+            self.world_size,
+            missing=[peer],
+            detail=(
+                f"ring {what} involving rank {peer} timed out mid-op after "
+                f"{_op_timeout():.0f}s (peer stalled, dead, or "
+                "partitioned)"
+            ),
+        )
+
     def _send_next(self, obj: Any) -> None:
-        _send_msg(self._next, pickle.dumps(obj, protocol=5))
+        # Deadline on the send half too: a one-way partition (we can
+        # receive, the peer can't drain) eventually fills the socket
+        # buffer and blocks sendall forever.
+        self._next.settimeout(_op_timeout())
+        try:
+            _send_msg(self._next, pickle.dumps(obj, protocol=5))
+        except socket.timeout:
+            self._fail_op("send", (self.rank + 1) % self.world_size)
 
     def _recv_prev(self) -> Any:
-        return pickle.loads(_recv_msg(self._prev))
+        self._prev.settimeout(_op_timeout())
+        try:
+            return pickle.loads(_recv_msg(self._prev))
+        except socket.timeout:
+            self._fail_op("recv", (self.rank - 1) % self.world_size)
 
     def _exchange(self, obj: Any) -> Any:
         """Send to next + recv from prev concurrently (large payloads would

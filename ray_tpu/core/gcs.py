@@ -22,7 +22,14 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ..exceptions import ActorNameTakenError, PlacementGroupError, SchedulingError
+from ..chaos.net import ChaosPartitionRpc
+from ..exceptions import (
+    ActorNameTakenError,
+    PlacementGroupError,
+    SchedulingError,
+    StaleNodeEpochError,
+)
+from ..observability.flight_recorder import record as _frec_record
 from ..utils import lock_order
 from ..observability.logs import get_logger as _get_logger
 from ..utils import internal_metrics as imet
@@ -43,11 +50,19 @@ def _is_hard_affinity(strategy: str) -> bool:
 TASK_TABLE_CAP = 50_000
 
 
-class GcsService:
+class GcsService(ChaosPartitionRpc):
     def __init__(self, snapshot_path: Optional[str] = None):
         self._lock = lock_order.tracked_rlock("gcs.state")
         self._snapshot_path = snapshot_path
         self._nodes: Dict[str, dict] = {}
+        # Monotonic per-node registration epochs (persisted): every
+        # register_node stamps the next epoch for that node id, and every
+        # raylet-originated RPC carries the epoch it was granted. A node
+        # the health loop declared dead whose RPCs resume (a healed
+        # partition's zombie) is FENCED: its calls are rejected with
+        # StaleNodeEpochError until it re-registers as a fresh
+        # incarnation — there is no silent resurrection path.
+        self._node_epochs: Dict[str, int] = {}
         self._actors: Dict[str, dict] = {}
         self._named: Dict[Tuple[str, str], str] = {}
         self._objects: Dict[str, Set[str]] = {}
@@ -64,6 +79,12 @@ class GcsService:
         # (free raced the task) is deleted on arrival.
         self._removed_pgs: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
         self._pg_creating: Set[str] = set()  # pending-PG retry in flight
+        # Actor restarts currently in flight (node-death path). Kept OFF
+        # the actor records: they are persisted (WAL/snapshot) and a
+        # transient CAS flag restored after a GCS restart would block
+        # that actor's restart path forever.
+        self._actor_restarting: Set[str] = set()
+        self._stranded_sweep_inflight = False  # one sweep thread at a time
         self._borrows: Dict[str, int] = {}
         self._deferred_free: Set[str] = set()
         self._free_queue: List[Tuple[float, List[str]]] = []
@@ -130,6 +151,7 @@ class GcsService:
     # RayletNotifyGCSRestart analogue, core_worker.proto:441).
     _PERSISTED = (
         "_nodes",
+        "_node_epochs",
         "_actors",
         "_named",
         "_pgs",
@@ -163,7 +185,7 @@ class GcsService:
                 if pg.get("state") == "REPLANNING":
                     pg["state"] = "RESCHEDULING"
 
-    _WAL_TABLES = ("_nodes", "_actors", "_named", "_pgs", "_kv")
+    _WAL_TABLES = ("_nodes", "_node_epochs", "_actors", "_named", "_pgs", "_kv")
 
     def _persist_delta(self, table: str, key, value) -> None:
         """Appends one control-table delta to the WAL (value=None deletes).
@@ -281,6 +303,12 @@ class GcsService:
         labels: Optional[dict] = None,
     ) -> dict:
         with self._lock:
+            # A fresh epoch per registration: a fenced/partitioned
+            # incarnation rejoining gets a new number, and everything
+            # still stamped with the old one stays rejected.
+            epoch = self._node_epochs.get(node_id, 0) + 1
+            self._node_epochs[node_id] = epoch
+            self._persist_delta("_node_epochs", node_id, epoch)
             self._nodes[node_id] = {
                 "sock": sock_path,
                 "store": store_path,
@@ -288,6 +316,7 @@ class GcsService:
                 "available": dict(resources),
                 "labels": dict(labels or {}),
                 "alive": True,
+                "epoch": epoch,
                 "last_hb": time.monotonic(),
             }
             self._persist_delta("_nodes", node_id, self._nodes[node_id])
@@ -297,37 +326,150 @@ class GcsService:
                 for pg_id, pg in self._pgs.items()
                 if pg.get("state") == "RESCHEDULING"
             ]
+        _frec_record("node.added", (node_id[:12], epoch))
         if retry_gangs:
             # A new host may complete a slice: retry stranded gangs.
             threading.Thread(
                 target=lambda: [self._reschedule_gang(p) for p in retry_gangs],
                 daemon=True,
             ).start()
+        # Node-death-stranded actors get the same treatment: new capacity
+        # is the retry trigger for their restart placement.
+        self._kick_stranded_restarts()
         # Capacity-wait subscribers (JaxTrainer's elastic renegotiation)
         # block on node_events instead of polling the node table: a join
         # is as much a lifecycle event as a drain.
         self.pubsub_publish(
             "node_events",
-            {"event": "node_added", "node_id": node_id, "ts": time.time()},
+            {"event": "node_added", "node_id": node_id, "epoch": epoch, "ts": time.time()},
         )
-        return {"ok": True, "nodes": n_alive}
+        return {"ok": True, "nodes": n_alive, "epoch": epoch}
 
-    def heartbeat(self, node_id: str, available: dict, stats: Optional[dict] = None) -> dict:
+    # ------------------------------------------------------------ fencing
+    def _mark_fenced_locked(self, node_id: str, n: dict) -> bool:
+        """Stamps the FENCED state on a dead/stale node record (lock
+        held). Returns True on the first fencing of this incarnation —
+        the caller publishes/counts outside the lock."""
+        if n.get("fenced"):
+            return False
+        n["alive"] = False  # fencing implies dead; never resurrect in place
+        n["fenced"] = True
+        n["fenced_ts"] = time.time()
+        self._persist_delta("_nodes", node_id, n)
+        return True
+
+    def _reject_stale_node(
+        self, node_id: str, epoch: Optional[int], context: str
+    ) -> None:
+        """The fence itself: raises StaleNodeEpochError when `node_id` is
+        dead-marked or `epoch` does not match the current registration.
+        Every raylet-originated mutation path calls this first — a
+        partitioned node that was declared dead keeps *executing*, but
+        nothing it says moves cluster state until it re-registers as a
+        fresh incarnation (no silent resurrection)."""
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n is None:
+                return  # unknown node: the caller's NACK path handles it
+            verdict = self._fence_verdict_locked(node_id, n, epoch)
+        if verdict is not None:
+            self._raise_fenced(node_id, epoch, verdict, context)
+
+    def _fence_verdict_locked(
+        self, node_id: str, n: dict, epoch: Optional[int]
+    ) -> Optional[Tuple[Optional[int], bool]]:
+        """Judges one raylet-originated call against the membership record
+        (lock held — callers that also mutate the record do both under ONE
+        acquisition, so the verdict and the mutation cannot interleave
+        with a concurrent re-registration). Returns None when the caller
+        is current, else (current_epoch, newly_fenced) with a dead-marked
+        record stamped FENCED."""
+        cur = n.get("epoch")
+        stale = epoch is not None and cur is not None and epoch != cur
+        if n["alive"] and not stale:
+            return None
+        newly_fenced = False
+        if not n["alive"]:
+            # Only a dead-marked record is stamped FENCED. A
+            # stale-epoch call against an ALIVE record is an OLD
+            # incarnation talking after its successor re-registered:
+            # the caller is rejected, but the CURRENT incarnation's
+            # record must not be touched.
+            newly_fenced = self._mark_fenced_locked(node_id, n)
+        return (cur, newly_fenced)
+
+    def _raise_fenced(
+        self,
+        node_id: str,
+        epoch: Optional[int],
+        verdict: Tuple[Optional[int], bool],
+        context: str,
+    ) -> None:
+        """Finalizes a fence rejection outside the lock: counts/records/
+        publishes on the FIRST fencing of an incarnation, then raises the
+        typed error every time."""
+        cur, newly_fenced = verdict
+        if newly_fenced:
+            imet.NODES_FENCED.inc()
+            _frec_record("node.fence", (node_id[:12], epoch, cur, context))
+            _log.warning(
+                "fencing node %s (%s; claimed epoch %s, current %s): "
+                "rejecting its RPCs until it re-registers",
+                node_id[:12], context, epoch, cur,
+            )
+            # Supervisors treat fencing exactly like death: same channel,
+            # its own event so post-mortems can tell the two apart.
+            self.pubsub_publish(
+                "node_events",
+                {
+                    "event": "node_fenced",
+                    "node_id": node_id,
+                    "epoch": epoch,
+                    "current_epoch": cur,
+                    "ts": time.time(),
+                },
+            )
+        raise StaleNodeEpochError(
+            node_id,
+            claimed_epoch=epoch,
+            current_epoch=cur,
+            reason=f"{context}: node is dead-marked or its epoch is stale",
+        )
+
+    def heartbeat(
+        self,
+        node_id: str,
+        available: dict,
+        stats: Optional[dict] = None,
+        epoch: Optional[int] = None,
+    ) -> dict:
         raylet_drained = False
         with self._lock:
             n = self._nodes.get(node_id)
             alive = sum(1 for m in self._nodes.values() if m["alive"])
             if n is None:
                 return {"ok": False, "nodes": alive}
-            n["available"] = dict(available)
-            if stats:
-                n["stats"] = dict(stats)
-                if stats.get("draining") and not n.get("draining"):
-                    raylet_drained = True
-            n["last_hb"] = time.monotonic()
-            if not n["alive"]:
-                n["alive"] = True
-                alive += 1
+            # Verdict and update under ONE lock acquisition: judging here
+            # and re-deriving inside _reject_stale_node left a window
+            # where a concurrent re-registration flipped the record
+            # between the two and a fenced-judged heartbeat returned ok
+            # without having applied its update.
+            verdict = self._fence_verdict_locked(node_id, n, epoch)
+            if verdict is None:
+                n["available"] = dict(available)
+                if stats:
+                    n["stats"] = dict(stats)
+                    if stats.get("draining") and not n.get("draining"):
+                        raylet_drained = True
+                n["last_hb"] = time.monotonic()
+        if verdict is not None:
+            # A heartbeat from a dead-marked node used to flip it back
+            # alive in place — the silent-resurrection bug: the zombie
+            # kept its workers, leases, and (GCS-side) a duplicate of
+            # every named actor already rescheduled elsewhere. Now it is
+            # NACKed with the typed fence error; the raylet reacts by
+            # killing its workers and re-registering as a fresh node.
+            self._raise_fenced(node_id, epoch, verdict, "heartbeat")
         if raylet_drained:
             # Raylet-initiated drain (chaos/local admin): adopt it through
             # the same path as a GCS-initiated one so scheduling exclusion,
@@ -361,8 +503,6 @@ class GcsService:
         if already:
             return True
         imet.NODES_DRAINED.inc()
-        from ..observability.flight_recorder import record as _frec_record
-
         _frec_record("node.drain_notice", (node_id[:12], deadline_s, reason))
         self._announce_draining(node_id, deadline_s, reason)
         # Flip the raylet into drain mode (best-effort: on a real
@@ -397,6 +537,17 @@ class GcsService:
         self._on_node_death(node_id)
         return True
 
+    @staticmethod
+    def _node_state(n: dict) -> str:
+        """The membership state machine's label for one node record:
+        ALIVE -> DRAINING (preemption notice) -> DEAD (heartbeat expiry /
+        drain deadline) -> FENCED (a dead-marked incarnation's RPCs came
+        back and were rejected) -> rejoin via register_node (node_added,
+        fresh epoch)."""
+        if n["alive"]:
+            return "DRAINING" if n.get("draining") else "ALIVE"
+        return "FENCED" if n.get("fenced") else "DEAD"
+
     def list_nodes(self) -> List[dict]:
         with self._lock:
             return [
@@ -406,6 +557,9 @@ class GcsService:
                  "Draining": bool(n.get("draining")),
                  "DrainReason": n.get("drain_reason"),
                  "DrainDeadline": n.get("drain_deadline"),
+                 "Epoch": n.get("epoch"),
+                 "Fenced": bool(n.get("fenced")),
+                 "State": self._node_state(n),
                  "sock": n["sock"], "store": n["store"]}
                 for nid, n in self._nodes.items()
             ]
@@ -693,6 +847,16 @@ class GcsService:
                     ]
                 for pg_id in stranded:
                     self._reschedule_gang(pg_id)
+                # Node-death-stranded actors get the same cadence: their
+                # restart placement can fail transiently (the chosen
+                # raylet partitioned/dying at create time), and waiting
+                # for the NEXT node registration would strand a named
+                # actor forever on a cluster that already has capacity.
+                # Off-thread: a create to a dying raylet can block on
+                # connect, and the health loop must keep beating (the
+                # in-memory _actor_restarting set dedupes overlapping
+                # sweeps per actor).
+                self._kick_stranded_restarts()
             dead = []
             lag_records: List[dict] = []
             with self._lock:
@@ -737,6 +901,7 @@ class GcsService:
         a member on the dead node co-fail and reschedule atomically."""
         # Death is also a node_event: supervisors subscribed for drain
         # notices learn about un-noticed failures from the same stream.
+        _frec_record("node.dead", (node_id[:12],))
         self.pubsub_publish(
             "node_events",
             {"event": "node_dead", "node_id": node_id, "ts": time.time()},
@@ -756,6 +921,7 @@ class GcsService:
                 target=lambda: [self._reschedule_gang(p) for p in gangs],
                 daemon=True,
             ).start()
+        restart_candidates: List[str] = []
         with self._lock:
             n = self._nodes.get(node_id)
             if n is not None:
@@ -776,12 +942,174 @@ class GcsService:
                     rec["reason"] = "node_died"
                     rec["ts"] = time.time()
             for aid, a in self._actors.items():
-                if a.get("node_id") == node_id and a["state"] in ("ALIVE", "PENDING"):
+                # RESTARTING is included: a restart whose target node died
+                # between placement and actor_started would otherwise keep
+                # node_id pinned to the corpse — invisible to both the
+                # death sweep (old condition) and the stranded-actor retry
+                # (which only takes node-less records) — a permanent wedge.
+                if a.get("node_id") == node_id and a["state"] in (
+                    "ALIVE", "PENDING", "RESTARTING",
+                ):
                     a["state"] = "RESTARTING" if self._can_restart(a) else "DEAD"
                     a["node_id"] = None
                     if a["state"] == "DEAD":
                         a["death_reason"] = f"node {node_id[:8]} died"
                         self._drop_name(aid)
+                    else:
+                        restart_candidates.append(aid)
+        if restart_candidates:
+            # Node death must DRIVE restarts: with the node gone there is
+            # no raylet left to report actor_died, so without this the
+            # actors sit RESTARTING forever and every named-actor lookup
+            # wedges (the exact liveness hole a partitioned node's
+            # rescheduled actors fall into).
+            threading.Thread(
+                target=lambda: [
+                    self._restart_actor(aid) for aid in restart_candidates
+                ],
+                daemon=True,
+            ).start()
+
+    def _restart_actor(self, actor_id: str) -> None:
+        """Re-places and re-creates one RESTARTING actor — the single
+        restart implementation behind both node death and raylet-reported
+        actor_died. No capacity now -> stays RESTARTING and is retried
+        when the next node registers (and on the health loop cadence)."""
+        with self._lock:
+            a = self._actors.get(actor_id)
+            if (
+                a is None
+                or a["state"] != "RESTARTING"
+                or a.get("node_id")
+                or actor_id in self._actor_restarting
+            ):
+                return
+            self._actor_restarting.add(actor_id)  # CAS: one restarter at a time
+            resources = dict(a["resources"])
+            pg_id = a.get("pg_id")
+            bundle_index = a.get("bundle_index", -1)
+            strategy = a.get("strategy", "DEFAULT")
+        try:
+            if pg_id:
+                node = self.pick_bundle(pg_id, bundle_index)
+            else:
+                node = self._place_with_strategy(resources, strategy)
+            if node is None:
+                # PERMANENTLY unplaceable restarts must FAIL VISIBLY, not
+                # wait in RESTARTING forever: the name would stay claimed
+                # and get_actor() would wedge with no failure signal. Two
+                # terminal cases: a hard-pinned actor (never migrates —
+                # only its own node id returning could satisfy it, which
+                # a caller cannot count on) and a bundle-pinned actor
+                # whose placement group was REMOVED (tombstoned; a PG
+                # mid-reschedule stays transient and keeps waiting).
+                with self._lock:
+                    pg_gone = bool(pg_id) and pg_id not in self._pgs
+                terminal_reason = None
+                if pg_gone:
+                    terminal_reason = (
+                        f"placement group {pg_id[:8]} removed; "
+                        "bundle-pinned restart impossible"
+                    )
+                elif not pg_id and _is_hard_affinity(strategy):
+                    terminal_reason = (
+                        "hard NodeAffinity target unavailable for restart"
+                    )
+                if terminal_reason is not None:
+                    with self._lock:
+                        a = self._actors.get(actor_id)
+                        if (
+                            a is not None
+                            and a["state"] == "RESTARTING"
+                            and not a.get("node_id")
+                        ):
+                            a["state"] = "DEAD"
+                            a["death_reason"] = terminal_reason
+                            self._drop_name(actor_id)
+                            self._persist_delta("_actors", actor_id, a)
+                    return
+                return  # no capacity yet: retried on the next node_added
+            with self._lock:
+                a = self._actors.get(actor_id)
+                if a is None or a["state"] != "RESTARTING" or a.get("node_id"):
+                    return  # raced a raylet-reported restart
+                a["node_id"] = node["node_id"]
+                spec_blob = a["spec_blob"]
+                self._persist_delta("_actors", actor_id, a)
+            try:
+                self._raylet_call(
+                    node["sock"], "create_actor", spec_blob, True,
+                    node.get("bundle_index", -1),
+                )
+            except Exception as e:
+                _log.warning("restart of actor %s on %s failed (%r); will retry",
+                             actor_id[:8], node["node_id"][:8], e)
+                with self._lock:
+                    a = self._actors.get(actor_id)
+                    if a is not None and a["state"] == "RESTARTING":
+                        # Back to stranded; retried later. Persisted: a
+                        # GCS restart restoring the record still pinned
+                        # to the failed target would hide it from the
+                        # stranded sweep forever.
+                        a["node_id"] = None
+                        self._persist_delta("_actors", actor_id, a)
+                return
+            with self._lock:
+                a = self._actors.get(actor_id)
+                if a is not None:
+                    # Budget accounting AFTER the create landed: one
+                    # logical restart = one increment. Charging each
+                    # placement ATTEMPT (transient create failures are
+                    # retried on a 2 s cadence) would silently exhaust a
+                    # finite max_restarts without ever restarting.
+                    a["num_restarts"] += 1
+                    self._persist_delta("_actors", actor_id, a)
+            imet.ACTOR_RESTARTS.inc()
+        finally:
+            with self._lock:
+                self._actor_restarting.discard(actor_id)
+
+    def _kick_stranded_restarts(self) -> None:
+        """Spawns one off-thread stranded-actor sweep, only when something
+        is actually stranded (a fleet re-registering after a GCS restart
+        must not fan out N no-op scan threads; off-thread because a create
+        to a dying raylet can block on connect and the caller — the health
+        loop or a register_node handler — must not stall)."""
+        with self._lock:
+            if self._stranded_sweep_inflight:
+                # A sweep snapshots the stranded set AFTER this flag is
+                # set, so any actor stranded before this kick is either
+                # in the running sweep or picked up within one health
+                # tick — no need for a second concurrent thread (a mass
+                # worker crash would otherwise fan out one per death).
+                return
+            has_stranded = any(
+                a["state"] == "RESTARTING" and not a.get("node_id")
+                for a in self._actors.values()
+            )
+            if not has_stranded:
+                return
+            self._stranded_sweep_inflight = True
+        threading.Thread(
+            target=self._restart_stranded_actors, daemon=True
+        ).start()
+
+    def _restart_stranded_actors(self) -> None:
+        """Retries node-death-stranded RESTARTING actors (no node yet) —
+        invoked when new capacity registers, mirroring the stranded-gang
+        retry."""
+        try:
+            with self._lock:
+                stranded = [
+                    aid
+                    for aid, a in self._actors.items()
+                    if a["state"] == "RESTARTING" and not a.get("node_id")
+                ]
+            for aid in stranded:
+                self._restart_actor(aid)
+        finally:
+            with self._lock:
+                self._stranded_sweep_inflight = False
 
     # ------------------------------------------------------------- actors
     @staticmethod
@@ -910,21 +1238,56 @@ class GcsService:
                 self._persist_delta("_named", key, actor_id)
         return node
 
-    def actor_started(self, actor_id: str, node_id: str) -> bool:
+    def actor_started(
+        self, actor_id: str, node_id: str, epoch: Optional[int] = None
+    ) -> bool:
+        # Fenced: a zombie reporting "started" for an actor the GCS has
+        # already rescheduled elsewhere would repoint the record at the
+        # duplicate instance.
+        self._reject_stale_node(node_id, epoch, "actor_started")
         with self._lock:
             a = self._actors.get(actor_id)
             if a:
+                if a["state"] == "DEAD" or a.get("node_id") not in (None, node_id):
+                    # The record is terminally dead, or pinned to another
+                    # node (an ambiguously-delivered create was retried
+                    # elsewhere while this instance was still launching):
+                    # this instance is a DUPLICATE. False tells the
+                    # reporting raylet to kill it locally — the singleton
+                    # invariant the fence protects, minus the partition.
+                    return False
                 a["state"] = "ALIVE"
                 a["node_id"] = node_id
                 self._persist_delta("_actors", actor_id, a)
         return True
 
-    def actor_died(self, actor_id: str, reason: str, no_restart: bool = False) -> dict:
-        """Returns the restart decision: {restart: bool, node: info}
-        (reference: actor state machine, design_docs/actor_states.rst)."""
+    def actor_died(
+        self,
+        actor_id: str,
+        reason: str,
+        no_restart: bool = False,
+        node_id: Optional[str] = None,
+        epoch: Optional[int] = None,
+    ) -> dict:
+        """Returns the restart decision: {restart: bool}; when True the
+        GCS re-places and re-creates the actor itself, off-thread, via
+        _restart_actor (reference: actor state machine,
+        design_docs/actor_states.rst).
+        Raylet reporters carry (node_id, epoch): a fenced incarnation's
+        death report must not touch an actor record — the GCS already
+        rescheduled it, and flipping the healthy successor to RESTARTING
+        here would be exactly the split-brain hijack the fence blocks on
+        every other mutation path."""
+        if node_id is not None:
+            self._reject_stale_node(node_id, epoch, "actor_died")
         with self._lock:
             a = self._actors.get(actor_id)
             if a is None:
+                return {"restart": False}
+            if node_id is not None and a.get("node_id") not in (None, node_id):
+                # The record moved (restarted elsewhere) since this
+                # reporter hosted it: a stale report about a bygone
+                # incarnation, not a death of the current one.
                 return {"restart": False}
             if no_restart or not self._can_restart(a):
                 a["state"] = "DEAD"
@@ -933,38 +1296,21 @@ class GcsService:
                 self._drop_name(actor_id)
                 self._persist_delta("_actors", actor_id, a)
                 return {"restart": False}
-            a["num_restarts"] += 1
+            # Flip to RESTARTING (unpinned) and hand off to the single
+            # place-pin-create-charge implementation (_restart_actor) —
+            # the same path node death uses. It charges num_restarts only
+            # once the create lands (placement/create retries of one
+            # death cost one budget unit, not one per attempt); a plain
+            # no-capacity outcome WAITS in RESTARTING (retried on every
+            # node_added + the health loop's cadence), while PERMANENTLY
+            # unplaceable restarts — hard NodeAffinity target gone, or
+            # the pinning placement group removed — go DEAD with the
+            # name dropped so callers get a failure signal, not a wedge.
             a["state"] = "RESTARTING"
-            imet.ACTOR_RESTARTS.inc()
+            a["node_id"] = None
             self._persist_delta("_actors", actor_id, a)
-            resources = dict(a["resources"])
-            pg_id = a.get("pg_id")
-            bundle_index = a.get("bundle_index", -1)
-            strategy = a.get("strategy", "DEFAULT")
-        if pg_id:
-            # Bundle-pinned actors restart on their reserved bundle.
-            node = self.pick_bundle(pg_id, bundle_index)
-        else:
-            # Restart honors the creation strategy: a hard-pinned actor
-            # whose node is gone dies instead of migrating silently.
-            node = self._place_with_strategy(resources, strategy)
-        with self._lock:
-            a = self._actors[actor_id]
-            if node is None:
-                a["state"] = "DEAD"
-                a["death_reason"] = (
-                    f"{reason}; hard NodeAffinity target unavailable for restart"
-                    if _is_hard_affinity(strategy)
-                    else f"{reason}; no node for restart"
-                )
-                self._drop_name(actor_id)
-                self._persist_delta("_actors", actor_id, a)
-                return {"restart": False}
-            a["node_id"] = node["node_id"]
-            self._persist_delta("_actors", actor_id, a)
-            return {"restart": True, "node": node, "spec_blob": a["spec_blob"],
-                    "bundle_index": node.get("bundle_index", -1),
-                    "num_restarts": a["num_restarts"]}
+        self._kick_stranded_restarts()
+        return {"restart": True}
 
     def get_actor(self, actor_id: str) -> Optional[dict]:
         with self._lock:
@@ -986,7 +1332,10 @@ class GcsService:
             self._objects.setdefault(oid_hex, set()).add(node_id)
         return True
 
-    def remove_object_location(self, oid_hex: str, node_id: str) -> bool:
+    def remove_object_location(
+        self, oid_hex: str, node_id: str, epoch: Optional[int] = None
+    ) -> bool:
+        self._reject_stale_node(node_id, epoch, "remove_object_location")
         with self._lock:
             locs = self._objects.get(oid_hex)
             if locs is not None:
@@ -1083,9 +1432,20 @@ class GcsService:
         return True
 
     # -------------------------------------------------------------- tasks
-    def node_sync(self, node_id: str, sealed: List[str], events: List[dict]) -> bool:
+    def node_sync(
+        self,
+        node_id: str,
+        sealed: List[str],
+        events: List[dict],
+        epoch: Optional[int] = None,
+    ) -> bool:
         """Batched raylet -> GCS sync: object locations + task state events
-        (reference: task_event_buffer.h batching + object directory adds)."""
+        (reference: task_event_buffer.h batching + object directory adds).
+        Epoch-fenced: a dead-marked/stale incarnation must not index
+        objects or mutate task state (its copies are already gone from
+        the directory; re-adding them would hand readers dangling
+        locations)."""
+        self._reject_stale_node(node_id, epoch, "node_sync")
         stale: List[str] = []
         node_sock = None
         with self._lock:
@@ -1595,6 +1955,17 @@ class GcsService:
     # ----------------------------------------------------------- control
     def ping(self) -> str:
         return "pong"
+
+    def flight_dump(self) -> Optional[str]:
+        """Dumps the GCS process's flight ring (node.dead / node.fence /
+        node.added and friends) so partition post-mortems can order the
+        membership transitions exactly."""
+        from ..observability import flight_recorder as _frec
+
+        return _frec.dump(reason="gcs flight_dump rpc")
+
+    # chaos_partition / chaos_heal: inherited from ChaosPartitionRpc
+    # (chaos/net.py) — one definition shared with the raylet.
 
     def stop(self) -> bool:
         self._stop.set()

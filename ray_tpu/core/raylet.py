@@ -33,6 +33,7 @@ from .. import exceptions as exc
 from .. import tracing as _tracing
 from ..chaos.controller import kill_now as _chaos_kill
 from ..chaos.controller import maybe_inject as _chaos_inject
+from ..chaos.net import ChaosPartitionRpc
 from ..utils import lock_order
 from ..observability.flight_recorder import record as _flight_record
 from ..observability.logs import get_logger as _get_logger
@@ -49,6 +50,11 @@ POLL_TIMEOUT_S = CONFIG.worker_poll_timeout_s
 _log = _get_logger("raylet")
 
 
+# Sentinel returned by RayletService._gcs_call_fenced when the call was
+# rejected with StaleNodeEpochError (the fence reaction has already run).
+_FENCED = object()
+
+
 class _Worker:
     def __init__(self, worker_id: str, proc: subprocess.Popen, env_key: str = ""):
         self.worker_id = worker_id
@@ -58,13 +64,16 @@ class _Worker:
         self.mailbox: "queue.Queue" = queue.Queue()
         self.busy_with: Optional[dict] = None  # task entry being executed
         self.actor_id: Optional[str] = None  # dedicated actor worker
+        self.actor_rec: Optional[dict] = None  # the exact record dict this
+        # worker serves: identity-compared on death so a re-created record
+        # (fresh dict) is never charged for a bygone worker's exit
         self.env_key = env_key  # runtime-env pool key (reference:
         # worker_pool.h PopWorker matching runtime_env_hash)
         self.last_done: Optional[str] = None  # idempotency: a retried
         # worker_step must not double-apply its completion report
 
 
-class RayletService:
+class RayletService(ChaosPartitionRpc):
     def __init__(
         self,
         node_id: str,
@@ -111,6 +120,7 @@ class RayletService:
             )
         else:
             self._free_chips = set(range(n_chips))
+        self._all_chips = frozenset(self._free_chips)
         if n_chips and len(self._free_chips) < n_chips:
             # An inherited TPU_VISIBLE_CHIPS restriction leaves fewer
             # leasable chips than the declared count. Clamp the schedulable
@@ -179,6 +189,25 @@ class RayletService:
         # work and lease grants are shed to other nodes while in-flight +
         # gang-pinned work finishes in the grace window.
         self._draining = False
+        # Membership epoch granted at registration; carried on every
+        # GCS-bound RPC. When the GCS answers StaleNodeEpochError this
+        # incarnation has been fenced (declared dead during a partition):
+        # _fence() kills the workers, drops leases/pins, and re-registers
+        # as a fresh incarnation with a new epoch.
+        self.epoch = 0
+        # Local incarnation token stamped on every queued entry; _fence
+        # regenerates it (at fence START) so a bygone life's queued work
+        # is identity-distinguishable from the current one's regardless
+        # of what the epoch NUMBER does across GCS resets.
+        self._incarnation: object = object()
+        self._fence_guard = threading.Lock()
+        self._fencing = False
+        # Highest epoch an actual fence has voided. self.epoch can ALSO
+        # advance without a fence (heartbeat re-register after a GCS
+        # snapshot loss) — callers whose batch was epoch-rejected consult
+        # this to tell "my data belongs to a dead incarnation" (drop)
+        # from "same healthy incarnation, new number" (resend).
+        self._max_fenced_epoch = 0
 
         # Worker zygote: a pre-warmed single-threaded forker that cuts the
         # ~2 s interpreter+jax startup of every fresh worker to a ~10 ms
@@ -261,6 +290,7 @@ class RayletService:
             "register_node", node_id, self.advertised, store_path, self.total, self.labels
         )
         self._cluster_size = reg.get("nodes", 1) if isinstance(reg, dict) else 1
+        self.epoch = reg.get("epoch", 0) if isinstance(reg, dict) else 0
         # Internal metrics: this raylet's hot-path instruments flush
         # through its existing GCS client (batched, off the fast path),
         # and the per-node ReporterAgent collects cpu/mem/fd/device
@@ -305,8 +335,14 @@ class RayletService:
 
     def _enqueue(self, entry: dict) -> None:
         """Queues one entry for the local scheduler; stamps queue-entry
-        time so dispatch can report queue-to-dispatch latency."""
+        time so dispatch can report queue-to-dispatch latency (and the
+        local incarnation token, so work queued by a later-fenced
+        incarnation is dropped at dispatch instead of double-executing —
+        the token, not the epoch NUMBER, because the epoch also advances
+        benignly on a GCS-snapshot-loss re-register, where queued work is
+        still legitimate, and numbers can repeat across GCS resets)."""
         entry["_q_ts"] = time.monotonic()
+        entry["_node_incarnation"] = self._incarnation
         _flight_record("sched.queue", (entry.get("task_id") or "")[:16])
         self._pending.put(entry)
         self._sched_wake.set()
@@ -317,15 +353,38 @@ class RayletService:
         while not self._stop.is_set():
             self._buf_wake.wait(timeout=0.2)
             self._buf_wake.clear()
+            # Epoch captured BEFORE the buffer pop: these entries belong
+            # to the incarnation that buffered them. A fence completing
+            # between pop and send would advance self.epoch — stamping
+            # the old life's sealed objects with the fresh epoch would
+            # slip them past the GCS's fence check and re-index locations
+            # it already dropped at node death. Captured-early, a raced
+            # sync is rejected and dropped (fail-safe).
+            ep = self.epoch
             with self._buf_lock:
                 locs, self._loc_buf = self._loc_buf, []
                 evts, self._evt_buf = self._evt_buf, []
             if not locs and not evts:
                 continue
             try:
-                self.gcs.call("node_sync", self.node_id, locs, evts)
+                self.gcs.call("node_sync", self.node_id, locs, evts, ep)
                 imet.GCS_SYNC_TOTAL.inc()
                 imet.GCS_SYNC_BATCH.observe(len(locs) + len(evts))
+            except exc.StaleNodeEpochError:
+                # This incarnation is fenced: its sealed objects and task
+                # events are void (the buffers die with the old life —
+                # re-syncing them post-rejoin would advertise dangling
+                # locations). _fence clears state and re-registers.
+                self._fence("node_sync", ep)
+                if ep > self._max_fenced_epoch:
+                    # The rejection was an epoch advance WITHOUT a fence
+                    # (heartbeat re-registered after a GCS snapshot loss):
+                    # this is still the same healthy incarnation and its
+                    # sealed objects are real — re-buffer so the next
+                    # flush re-indexes them under the current epoch.
+                    with self._buf_lock:
+                        self._loc_buf = locs + self._loc_buf
+                        self._evt_buf = evts + self._evt_buf
             except Exception:
                 with self._buf_lock:  # GCS briefly unreachable: retry later
                     self._loc_buf = locs + self._loc_buf
@@ -778,6 +837,12 @@ class RayletService:
         if bundle_index is not None and bundle_index >= 0:
             entry["bundle_index"] = bundle_index
         with self._actor_lock:
+            existing = self._actors.get(entry["actor_id"])
+            if existing is not None and existing["state"] != "DEAD":
+                # Duplicate delivery: RpcClient.call resends its payload
+                # after a reconnect, so the GCS's create can arrive twice.
+                # Hosting it twice would launch a second live instance.
+                return True
             self._actors[entry["actor_id"]] = {
                 "worker_id": None,
                 "state": "PENDING",
@@ -815,12 +880,20 @@ class RayletService:
             wid = a.get("worker_id") if a else None
             if a:
                 a["state"] = "DEAD"
-        self.gcs.call("actor_died", actor_id, "killed via kill()", no_restart)
+        # Worker dies BEFORE the GCS hears about it: with restart allowed
+        # the GCS re-creates immediately (possibly on this very node,
+        # overwriting the local DEAD record) — killing the old worker
+        # after that would misattribute its death to the fresh record and
+        # trigger a second restart.
         if wid:
             with self._workers_lock:
                 w = self._workers.get(wid)
             if w:
                 w.proc.kill()
+        self._gcs_call_fenced(
+            "kill_actor", "actor_died", actor_id, "killed via kill()",
+            no_restart, self.node_id,
+        )
         return True
 
     # ------------------------------------------------------- object plane
@@ -1173,7 +1246,9 @@ class RayletService:
                 with self._spill_lock:
                     self._local_objects.pop(h, None)
                 try:
-                    self.gcs.call("remove_object_location", h, self.node_id)
+                    self.gcs.call(
+                        "remove_object_location", h, self.node_id, self.epoch
+                    )
                 except Exception:  # lint: swallow-ok(directory heals via node_sync batches)
                     pass
                 return True
@@ -1842,13 +1917,42 @@ class RayletService:
                         a = self._actors.get(aid)
                         if a:
                             a["state"] = "ALIVE"
-                    self.gcs.call("actor_started", aid, self.node_id)
+                    # _FENCED (fenced mid-launch: the GCS already moved
+                    # this actor; our instance dies with the fence) is
+                    # not False, so it skips the duplicate-kill below.
+                    accepted = self._gcs_call_fenced(
+                        "actor_started", "actor_started", aid, self.node_id
+                    )
+                    if accepted is False:
+                        # The record moved (or died) while our create
+                        # was in flight: this instance is a duplicate.
+                        # Kill it locally WITHOUT an actor_died report
+                        # — the record is not ours to touch; the
+                        # monitor sees state DEAD and stays silent.
+                        _log.warning(
+                            "actor %s started here but the GCS record "
+                            "points elsewhere: killing the duplicate "
+                            "instance", aid[:8],
+                        )
+                        with self._actor_lock:
+                            a = self._actors.get(aid)
+                            wid = a.get("worker_id") if a else None
+                            if a:
+                                a["state"] = "DEAD"
+                        if wid:
+                            with self._workers_lock:
+                                w = self._workers.get(wid)
+                            if w:
+                                w.proc.kill()
                 else:
                     with self._actor_lock:
                         a = self._actors.get(aid)
                         if a:
                             a["state"] = "DEAD"
-                    self.gcs.call("actor_died", aid, "constructor failed", True)
+                    self._gcs_call_fenced(
+                        "actor_died", "actor_died", aid,
+                        "constructor failed", True, self.node_id,
+                    )
         self._sched_wake.set()  # freed worker/resources: dispatch more
         return True
 
@@ -1933,6 +2037,21 @@ class RayletService:
 
     def _dispatch(self, entry: dict) -> bool:
         kind = entry["type"]
+        if entry.get("_node_incarnation", self._incarnation) is not self._incarnation:
+            # Queued by a since-fenced incarnation (it sat dep-blocked in
+            # _waiting across the fence; the token regenerates at fence
+            # START, so this holds even mid-fence and when re-registration
+            # is still failing): the GCS already failed this node's tasks
+            # at death and the owner has retried elsewhere —
+            # executing it here too would double-apply its side effects.
+            # Dropped SILENTLY: a FAILED event here would carry the fresh
+            # epoch, slip past the GCS fence, and clobber a live retry's
+            # RUNNING record (the owner would resubmit a second time while
+            # the retry still runs, and the retry's eventual FINISHED
+            # would be blocked by the terminal-state rule). No error
+            # object either: the owner's retry reuses these return ids.
+            _flight_record("sched.drop_stale_epoch", (entry.get("task_id") or "")[:16])
+            return True
         if entry.get("task_id") in self._cancelled:
             self._cancelled.pop(entry["task_id"], None)
             self._store_error_for(
@@ -1963,8 +2082,9 @@ class RayletService:
                     a = self._actors.get(entry["actor_id"])
                     if a:
                         a["state"] = "DEAD"
-                self.gcs.call(
-                    "actor_died", entry["actor_id"], "placement bundle gone", True
+                self._gcs_call_fenced(
+                    "actor_died", "actor_died", entry["actor_id"],
+                    "placement bundle gone", True, self.node_id,
                 )
                 return True
             if not self._try_acquire_entry(entry):
@@ -2003,6 +2123,7 @@ class RayletService:
                 if a is not None:
                     a["worker_id"] = w.worker_id
                     a["resources_held"] = True
+                    w.actor_rec = a
             w.busy_with = entry
             self._task_event(entry["task_id"], "RUNNING")
             w.mailbox.put({"type": "task", "entry": entry})
@@ -2409,6 +2530,18 @@ class RayletService:
             a = self._actors.get(aid)
             if a is None:
                 return
+            if (w.actor_rec is not None and a is not w.actor_rec) or a.get(
+                "worker_id"
+            ) not in (None, w.worker_id):
+                # The record was already re-created (a kill-with-restart's
+                # fresh instance landed back on this node before the old
+                # worker's death was processed): this death belongs to the
+                # BYGONE instance — touching the fresh record would
+                # misattribute it and trigger a second restart. The
+                # identity compare catches even a still-PENDING fresh
+                # record (worker_id None) — create_actor installs a new
+                # dict, so `is` distinguishes incarnations exactly.
+                return
             was_dead = a["state"] == "DEAD"  # deliberate kill_actor()
             a["state"] = "DEAD"
             a["worker_id"] = None
@@ -2433,17 +2566,15 @@ class RayletService:
             self._release_entry(creation_entry)
         if was_dead:
             return  # killed deliberately; GCS already informed, no restart
-        decision = self.gcs.call(
-            "actor_died", aid, f"worker process died{tail_note[:1200]}", False
+        # Restart (place + create + budget charge) is the GCS's job: it
+        # re-places off-thread via the same _restart_actor path node
+        # death uses. _FENCED: this incarnation was fenced while the
+        # worker died — the GCS has already rescheduled the actor, and
+        # reporting would hijack the healthy successor; die as a member.
+        self._gcs_call_fenced(
+            "actor_died", "actor_died", aid,
+            f"worker process died{tail_note[:1200]}", False, self.node_id,
         )
-        if decision.get("restart"):
-            node = decision["node"]
-            spec_blob = decision["spec_blob"]
-            bidx = decision.get("bundle_index")
-            if node["node_id"] == self.node_id:
-                self.create_actor(spec_blob, forwarded=True, bundle_index=bidx)
-            else:
-                self._remote(node["sock"]).call("create_actor", spec_blob, True, bidx)
 
     # ---------------------------------------------------------- lifecycle
     def _heartbeat_loop(self) -> None:
@@ -2481,14 +2612,21 @@ class RayletService:
                 # set it there first.
                 stats["draining"] = True
             try:
-                reply = self.gcs.call("heartbeat", self.node_id, avail, stats)
+                # _FENCED: the GCS declared this node dead while a
+                # partition hid its heartbeats — this incarnation is a
+                # zombie; _fence kills its workers and rejoins fresh
+                # (never resurrect in place). Not a dict, so it skips the
+                # reply handling below.
+                reply = self._gcs_call_fenced(
+                    "heartbeat", "heartbeat", self.node_id, avail, stats
+                )
                 if isinstance(reply, dict):
                     self._cluster_size = reply.get("nodes", self._cluster_size)
                     if not reply.get("ok", True):
                         # The GCS restarted without our registration (lost
                         # or stale snapshot): re-register (reference:
                         # RayletNotifyGCSRestart, core_worker.proto:441).
-                        self.gcs.call(
+                        reg = self.gcs.call(
                             "register_node",
                             self.node_id,
                             self.advertised,
@@ -2496,6 +2634,8 @@ class RayletService:
                             self.total,
                             self.labels,
                         )
+                        if isinstance(reg, dict):
+                            self.epoch = reg.get("epoch", self.epoch)
             except Exception as e:
                 # Missed heartbeats are how this node gets declared dead:
                 # say so while it is still alive to say anything.
@@ -2503,6 +2643,137 @@ class RayletService:
 
     def ping(self) -> str:
         return "pong"
+
+    def _gcs_call_fenced(self, origin: str, method: str, *args) -> Any:
+        """One epoch-fenced GCS mutation: captures self.epoch BEFORE the
+        call, appends it as the RPC's epoch argument, and on
+        StaleNodeEpochError runs the fence reaction for exactly the
+        incarnation that spoke (the early capture is what lets _fence
+        ignore rejections a completed fence already superseded). Returns
+        _FENCED on rejection, the RPC result otherwise."""
+        ep = self.epoch
+        try:
+            return self.gcs.call(method, *args, ep)
+        except exc.StaleNodeEpochError:
+            self._fence(origin, ep)
+            return _FENCED
+
+    def _fence(self, origin: str, epoch: Optional[int] = None) -> None:
+        """Reaction to StaleNodeEpochError: the GCS declared this
+        incarnation dead (partition, drain deadline) and has already
+        rescheduled its actors and dropped its object locations. Acting
+        on any of that state would be split-brain, so this node DIES AS A
+        MEMBER — every worker is killed (duplicate named-actor instances
+        die here), leases/bundles/chip leases and plasma pins are
+        dropped, queued work is discarded (owners recover through the
+        task table) — and then rejoins as a FRESH incarnation with a new
+        epoch, indistinguishable from a brand-new node_added.
+
+        `epoch` is the epoch the REJECTED RPC carried: when another
+        thread's fence already completed (self.epoch advanced), the
+        rejection is about a bygone incarnation and must be ignored —
+        re-fencing here would SIGKILL the fresh incarnation's workers
+        with the GCS none the wiser (no node_dead ever fires for them)."""
+        with self._fence_guard:
+            if self._fencing or self._stop.is_set():
+                return
+            if epoch is not None and epoch != self.epoch:
+                return  # a completed fence already superseded this rejection
+            self._fencing = True
+            self._max_fenced_epoch = max(self._max_fenced_epoch, self.epoch)
+            # New incarnation token at fence START: entries stamped by the
+            # old life are droppable at dispatch immediately — during the
+            # fence window itself, and even if re-registration below keeps
+            # failing (self.epoch only advances on a successful register).
+            self._incarnation = object()
+        old_epoch = self.epoch
+        try:
+            _flight_record("node.fence", (self.node_id[:12], old_epoch, origin))
+            _log.warning(
+                "node %s (epoch %s) fenced by the GCS via %s: killing "
+                "workers, dropping leases, re-registering fresh",
+                self.node_id[:12], old_epoch, origin,
+            )
+            # Workers first: the old incarnation's actor instances and
+            # in-flight tasks must stop producing side effects. Removed
+            # from the table BEFORE the kill so the monitor loop never
+            # reports their deaths as crashes of the (already-moved)
+            # actor records.
+            with self._workers_lock:
+                victims = list(self._workers.values())
+                self._workers.clear()
+                self._idle.clear()
+            for w in victims:
+                try:
+                    w.proc.kill()
+                except OSError:
+                    pass
+            for w in victims:
+                # Reap: these workers left the monitor's table above, so
+                # nothing else will wait() them — an unreaped Popen child
+                # lingers as a defunct /proc entry that looks like a
+                # surviving zombie instance. (Zygote-forked workers are
+                # reaped by the zygote; their PidHandle has no wait.)
+                waiter = getattr(w.proc, "wait", None)
+                if waiter is not None:
+                    try:
+                        waiter(timeout=2.0)
+                    except Exception:  # lint: swallow-ok(best-effort reap of a SIGKILLed child)
+                        pass
+            self._leases.clear()
+            with self._actor_lock:
+                self._actors.clear()
+            with self._res_lock:
+                self._bundles.clear()
+                self.available = dict(self.total)
+                self._free_chips = set(self._all_chips)
+            with self._seen_lock:
+                self._seen_submits.clear()
+            # Queued work belongs to the old life; owners have already
+            # been failed over by the GCS (tasks marked FAILED at node
+            # death). Entries parked in _waiting are fenced at dispatch
+            # by their stale epoch stamp.
+            try:
+                while True:
+                    self._pending.get_nowait()
+            except queue.Empty:
+                pass
+            with self._buf_lock:
+                self._loc_buf.clear()
+                self._evt_buf.clear()
+            # Plasma pins: the directory already dropped this node's
+            # locations; forget the old life's primaries so post-rejoin
+            # syncs cannot re-advertise them.
+            with self._spill_lock:
+                self._local_objects.clear()
+                self._spilled.clear()
+            self._draining = False
+            reg = self.gcs.call(
+                "register_node",
+                self.node_id,
+                self.advertised,
+                self.store_path,
+                self.total,
+                self.labels,
+            )
+            if isinstance(reg, dict):
+                self.epoch = reg.get("epoch", 0)
+                self._cluster_size = reg.get("nodes", self._cluster_size)
+            _log.warning(
+                "node %s rejoined as epoch %s", self.node_id[:12], self.epoch
+            )
+            self._sched_wake.set()
+        except Exception as e:
+            # Re-registration can fail (the partition re-formed): the next
+            # fenced heartbeat retries the whole sequence.
+            _log.warning("fence of node %s did not complete (%r); will retry",
+                         self.node_id[:12], e)
+        finally:
+            with self._fence_guard:
+                self._fencing = False
+
+    # chaos_partition / chaos_heal: inherited from ChaosPartitionRpc
+    # (chaos/net.py) — one definition shared with the GCS.
 
     def drain(self, deadline_s: float = 30.0) -> bool:
         """Preemption-notice handling (reference: the DrainNode RPC,

@@ -23,9 +23,21 @@ import time
 import uuid
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ..chaos import net as _netpart
+from ..chaos.controller import controller as _chaos_controller
+from ..chaos.controller import maybe_inject as _chaos_inject
 from ..exceptions import RpcUnavailableError
 
 _HDR = struct.Struct("<I")
+
+
+def _net_chaos_armed() -> bool:
+    """Disarmed fast path for the net.* injection points: two global
+    loads + None checks (same budget class as maybe_inject itself) —
+    detail strings and partition lookups are only built when armed."""
+    return _chaos_controller() is not None or _netpart.active()
+
+
 # First frame of an authenticated TCP connection: RTPUAUTH:<token>.
 # The control plane speaks pickle, so an open TCP port is arbitrary code
 # execution for anyone who can reach it (the reference has the same
@@ -239,6 +251,36 @@ class RpcClient:
         last_err: Optional[Exception] = None
         attempt = 0
         while True:
+            if _net_chaos_armed():
+                # net.connect faults: an active partition (or a `drop`
+                # rule) makes this attempt vanish on the wire — the
+                # retry loop burns the caller's own deadline, exactly
+                # like packets on the floor. `raise` fails the whole
+                # connect immediately.
+                blocked = _netpart.blocked_addr(self.path)
+                rule = None if blocked else _chaos_inject("net.connect", self.path)
+                if rule is not None and rule.action == "raise":
+                    raise RpcUnavailableError(
+                        self.path,
+                        time.monotonic() - start,
+                        attempt,
+                        ConnectionError("chaos: injected connect failure"),
+                    )
+                if blocked is not None or rule is not None:
+                    if blocked is not None:
+                        _netpart.note_drop(self.path, "connect")
+                    last_err = ConnectionError(
+                        "chaos: connect black-holed"
+                        + (" by partition" if blocked else " by net.connect rule")
+                    )
+                    attempt += 1
+                    now = time.monotonic()
+                    if now >= deadline:
+                        raise RpcUnavailableError(
+                            self.path, now - start, attempt, last_err
+                        )
+                    time.sleep(min(self._BACKOFF_BASE_S, deadline - now))
+                    continue
             try:
                 if kind == "tcp":
                     s = socket.create_connection(target, timeout=10.0)
@@ -287,7 +329,42 @@ class RpcClient:
             self._tls.sock = sock
         return sock
 
+    def _chaos_gate(self, method: str, oneway: bool) -> bool:
+        """net.call faults (only reached when armed): returns True when a
+        one-way message must vanish; two-way calls raise — a black hole
+        gives a request/reply protocol no reply to wait for, and the
+        typed connection error is what every control-plane caller already
+        handles as 'peer gone'."""
+        blocked = _netpart.blocked_addr(self.path)
+        if blocked is not None:
+            _netpart.note_drop(self.path, method)
+            if oneway:
+                return True
+            raise RpcUnavailableError(
+                self.path, 0.0, 0,
+                ConnectionError(f"chaos partition black-holed {method!r}"),
+            )
+        rule = _chaos_inject("net.call", f"{self.path}|{method}")
+        if rule is not None:
+            if rule.action == "delay":
+                time.sleep(rule.delay_s)
+            elif rule.action == "drop":
+                if oneway:
+                    return True
+                raise RpcUnavailableError(
+                    self.path, 0.0, 0,
+                    ConnectionError(f"chaos net.call dropped {method!r}"),
+                )
+            else:  # raise
+                raise RpcUnavailableError(
+                    self.path, 0.0, 0,
+                    ConnectionError(f"chaos net.call failed {method!r}"),
+                )
+        return False
+
     def call(self, method: str, *args, timeout: Optional[float] = None, **kwargs) -> Any:
+        if _net_chaos_armed():
+            self._chaos_gate(method, oneway=False)
         req_id = uuid.uuid4().hex
         payload = pickle.dumps((req_id, method, args, kwargs))
         sock = self._get_sock()
@@ -312,6 +389,8 @@ class RpcClient:
     def notify(self, method: str, *args, **kwargs) -> None:
         """One-way call: no reply, no roundtrip wait (the analogue of the
         reference's fire-and-forget task submission direction)."""
+        if _net_chaos_armed() and self._chaos_gate(method, oneway=True):
+            return  # black-holed: a one-way send just vanishes
         payload = pickle.dumps((None, method, args, kwargs))
         sock = self._get_sock()
         sock.settimeout(None)

@@ -149,6 +149,42 @@ class CapacityTimeoutError(RayTpuError, TimeoutError):
         )
 
 
+class StaleNodeEpochError(RayTpuError, ConnectionError):
+    """An RPC arrived from a node incarnation the GCS has fenced: the
+    node was declared dead (heartbeat expiry during a partition, drain
+    deadline) or the epoch it carries is not the one the GCS stamped at
+    its registration. The caller is a zombie — it must stop acting on
+    cluster state it no longer owns (kill workers, drop leases and
+    plasma pins) and re-register as a fresh incarnation with a new
+    epoch. Subclasses ConnectionError so generic transport handlers
+    treat it as loss of the control-plane session, never as data."""
+
+    def __init__(
+        self,
+        node_id: str = "",
+        claimed_epoch: Optional[int] = None,
+        current_epoch: Optional[int] = None,
+        reason: str = "node declared dead",
+    ):
+        self.node_id = node_id
+        self.claimed_epoch = claimed_epoch
+        self.current_epoch = current_epoch
+        self.reason = reason
+        super().__init__(
+            f"node {node_id[:12]} is fenced ({reason}; "
+            f"claimed epoch {claimed_epoch}, current {current_epoch}): "
+            "kill workers, drop leases, and re-register as a fresh node"
+        )
+
+    def __reduce__(self):
+        # Keep the structured fields across the RPC pickle boundary
+        # (default Exception pickling would re-init with the message).
+        return (
+            StaleNodeEpochError,
+            (self.node_id, self.claimed_epoch, self.current_epoch, self.reason),
+        )
+
+
 class TaskCancelledError(RayTpuError):
     pass
 

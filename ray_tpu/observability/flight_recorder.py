@@ -51,7 +51,8 @@ KIND_PREFIXES = {
     "chaos",     # chaos controller injections
     "coll",      # collective rendezvous/ops
     "lock",      # utils/lock_order.py order-cycle / long-hold reports
-    "node",      # node lifecycle (drain notices)
+    "net",       # chaos network partitions (install/heal/blocked sends)
+    "node",      # node lifecycle (drain notices, death, fencing, rejoin)
     "sched",     # raylet scheduler queue/dispatch
     "train",     # trainer drain/restore/elastic transitions
     "watchdog",  # SLO watchdog alerts

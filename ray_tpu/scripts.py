@@ -168,6 +168,10 @@ def cmd_status(args) -> None:
         mark = "up" if n["Alive"] else "DOWN"
         if n["Alive"] and n.get("Draining"):
             mark = "DRAINING"  # preemption notice received; node departing
+        elif not n["Alive"] and n.get("Fenced"):
+            # Declared dead, then heard from again (healed partition):
+            # its RPCs are being rejected until it re-registers fresh.
+            mark = "FENCED"
         labels = n.get("Labels") or {}
         slice_info = ""
         if labels.get("slice_name"):
@@ -179,8 +183,9 @@ def cmd_status(args) -> None:
             )
             if labels.get("tpu_topology"):
                 slice_info += f" topology={labels['tpu_topology']}"
+        epoch_info = f" epoch={n['Epoch']}" if n.get("Epoch") is not None else ""
         print(
-            f"  [{mark}] {n['NodeID'][:12]} resources={n['Resources']} "
+            f"  [{mark}] {n['NodeID'][:12]}{epoch_info} resources={n['Resources']} "
             f"available={n['Available']} workers={n['Stats'].get('num_workers', 0)}"
             f"{slice_info}"
         )

@@ -717,6 +717,22 @@ CHAOS_INJECTIONS = Counter(
     component="chaos",
     tag_keys=("point", "action"),
 )
+NODES_FENCED = Counter(
+    "raytpu_nodes_fenced_total",
+    "Dead-marked nodes whose later RPCs were rejected with "
+    "StaleNodeEpochError (split-brain zombies forced to re-register)",
+    component="gcs",
+)
+NET_PARTITIONS = Counter(
+    "raytpu_net_partitions_total",
+    "Network-partition specs installed in this process by chaos.partition",
+    component="chaos",
+)
+NET_BLOCKED = Counter(
+    "raytpu_net_blocked_total",
+    "Control-plane sends/connects black-holed by an active chaos partition",
+    component="chaos",
+)
 NODE_HEARTBEAT_LAG = Gauge(
     "raytpu_node_heartbeat_lag_s",
     "Seconds since each alive node's last raylet heartbeat (GCS-reported)",

@@ -6,7 +6,13 @@ The GCS publishes to the general-purpose ``node_events`` pubsub channel:
   preemption notice arrives (``report_preemption`` — synthesized by chaos,
   the local provider's ``inject_preemption``, or relayed from a cloud API),
 - ``{"event": "node_dead", "node_id"}`` when a node is declared dead
-  (heartbeat expiry or explicit drain_node).
+  (heartbeat expiry or explicit drain_node),
+- ``{"event": "node_fenced", "node_id", "epoch"}`` when a dead-marked
+  node's RPCs resumed (healed partition) and were rejected with
+  StaleNodeEpochError — supervisors treat it exactly like death (the
+  node already left the membership; fencing only makes the zombie stop),
+- ``{"event": "node_added", "node_id", "epoch"}`` when a node registers
+  (first join, or a fenced incarnation rejoining fresh).
 
 `NodeEventWatcher` is the subscriber side: a daemon thread long-polls the
 channel and maintains the cumulative ``draining`` / ``dead`` node-id sets.
@@ -34,6 +40,7 @@ class NodeEventWatcher:
         self.draining: Set[str] = set()
         self.dead: Set[str] = set()
         self.added: Set[str] = set()
+        self.fenced: Set[str] = set()
         # Grows only: nodes that EVER received a drain notice. `draining`
         # reflects current state (a dead node leaves it); supervisors
         # distinguishing "noticed preemption" from "un-noticed crash"
@@ -74,6 +81,14 @@ class NodeEventWatcher:
                     elif msg.get("event") == "node_dead":
                         self.dead.add(nid)
                         # A dead node is no longer "draining" — it's gone.
+                        self.draining.discard(nid)
+                    elif msg.get("event") == "node_fenced":
+                        # Fencing IS death from a supervisor's view (the
+                        # membership loss happened at node_dead; this is
+                        # the zombie being put down) — same reaction,
+                        # tracked separately for post-mortems.
+                        self.fenced.add(nid)
+                        self.dead.add(nid)
                         self.draining.discard(nid)
                     elif msg.get("event") == "node_added":
                         self.added.add(nid)

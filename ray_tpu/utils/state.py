@@ -29,7 +29,12 @@ def _gcs():
 
 
 def list_nodes() -> List[Dict[str, Any]]:
-    """Nodes with liveness, resources, labels, and store gauges."""
+    """Nodes with liveness, resources, labels, and store gauges — plus
+    membership identity: `Epoch` (the registration epoch the GCS stamped
+    on the current incarnation) and `State`, the membership state machine
+    label (ALIVE / DRAINING / DEAD / FENCED; a FENCED node is a
+    dead-marked incarnation whose RPCs came back after a partition and
+    are being rejected until it re-registers)."""
     return _gcs().call("list_nodes")
 
 

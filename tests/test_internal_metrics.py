@@ -67,8 +67,19 @@ def test_hot_paths_emit_on_two_nodes(two_node):
     def families():
         recs = state.internal_metrics()
         comps = {m["tags"].get("component") for m in recs}
+        names = {m["name"] for m in recs}
         want = {"scheduler", "worker_pool", "zygote", "gcs", "object_transport", "reporter"}
-        return recs if want <= comps else None
+        # The per-name assertions below don't poll, but each counter
+        # flushes on its emitting process's ~1 s cadence (node2's
+        # bytes-in lands a beat after head-side transport metrics make
+        # `object_transport` visible) — wait for all of them here.
+        want_names = {
+            "raytpu_sched_dispatch_latency_ms",
+            "raytpu_gcs_rpc_total",
+            "raytpu_object_bytes_in_total",
+            "raytpu_worker_spawn_total",
+        }
+        return recs if (want <= comps and want_names <= names) else None
 
     recs = _wait_for(families)
     assert recs, f"missing components in {sorted({m['tags'].get('component') for m in state.internal_metrics()})}"
@@ -90,11 +101,18 @@ def test_hot_paths_emit_on_two_nodes(two_node):
     }
     assert cluster.head_node_id in pool_nodes and node2 in pool_nodes
 
-    # GCS RPC metrics carry the method tag.
-    methods = {
-        m["tags"].get("method") for m in recs if m["name"] == "raytpu_gcs_rpc_total"
-    }
-    assert "heartbeat" in methods
+    # GCS RPC metrics carry the method tag. Polled on a FRESH read: the
+    # `recs` snapshot above can predate the first 1 s-interval heartbeat
+    # (boot-time register_node/node_sync satisfy the family wait first),
+    # and asserting on the stale snapshot flaked.
+    def heartbeat_method_tag():
+        return "heartbeat" in {
+            m["tags"].get("method")
+            for m in state.internal_metrics()
+            if m["name"] == "raytpu_gcs_rpc_total"
+        }
+
+    assert _wait_for(heartbeat_method_tag)
 
 
 def test_reporter_agent_gauges_per_node(two_node):

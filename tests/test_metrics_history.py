@@ -629,6 +629,13 @@ def test_metrics_history_cluster_acceptance():
 
         def multi_sample():
             rt.get([f.remote(i) for i in range(10)])
+            # Deterministic store puts: tiny task results ride the
+            # fastpath's inline-ack memstore and may NEVER touch shm
+            # (whether any do depends on which submission path each task
+            # races onto — the old flake). An explicit put() always
+            # lands in the pool, so the gate metric accrues every round.
+            ref = rt.put(b"x" * (64 << 10))
+            del ref
             series = state.metrics_history(
                 "raytpu_store_puts_total", window_s=120.0
             )

@@ -79,9 +79,13 @@ def test_cancel_running_task(rt_cluster):
         rt.get(ref, timeout=15)
 
 
-def test_cancel_queued_task(rt_cluster):
+def test_cancel_queued_task(rt_cluster, tmp_path):
+    marker = str(tmp_path / "hog_started")
+
     @rt.remote(num_cpus=4)
-    def hog():
+    def hog(path):
+        with open(path, "w") as f:
+            f.write("1")
         time.sleep(3)
         return "hogged"
 
@@ -89,7 +93,16 @@ def test_cancel_queued_task(rt_cluster):
     def queued():
         return "ran"
 
-    h = hog.remote()
+    h = hog.remote(marker)
+    # The premise is "q sits queued BEHIND the hog": prove the hog is
+    # actually executing (CPUs held) before submitting q — dispatch
+    # ordering between two same-demand submissions is not guaranteed,
+    # and a q that sneaks in first finishes before the cancel lands
+    # (the old ~15% module-context flake).
+    deadline = time.monotonic() + 20
+    while not os.path.exists(marker):
+        assert time.monotonic() < deadline, "hog never started"
+        time.sleep(0.05)
     q = queued.remote()  # cannot start while hog holds all CPUs
     time.sleep(0.3)
     rt.cancel(q)

@@ -139,7 +139,14 @@ def test_impala_cartpole_runs_and_improves(rt):
         num_env_runners=2, num_envs_per_runner=4, rollout_length=32, seed=3
     ).build()
     best = -np.inf
-    for i in range(60):
+    # Budget: the old 60-iteration cap sat exactly at the learning
+    # curve's crossing knee — IMPALA improves monotonically here, but
+    # the async sample pipeline makes the iteration-to-sample alignment
+    # nondeterministic, so same-seed runs cross the 60-return gate
+    # anywhere between ~iter 36 and ~66 (measured across seeds 0/1/3) —
+    # a coin-flip flake. 150 gives >2x headroom over the worst observed
+    # crossing; break-on-success keeps the common case at ~10 s.
+    for i in range(150):
         result = algo.train()
         r = result.get("episode_return_mean")
         if r is not None and np.isfinite(r):

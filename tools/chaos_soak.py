@@ -1,0 +1,484 @@
+"""Jepsen-style membership soak: seeded partition/heal/kill/preempt campaign.
+
+Drives a live cluster through randomized (but seeded — two runs with the
+same ``--seed`` replay the same campaign) membership weather while three
+workloads run continuously, and checks linearizable-register-style
+invariants after every event:
+
+- **Named-actor singleton**: the GCS actor table never shows more than
+  one ALIVE record for the soak counter, and at quiesce exactly one live
+  instance answers — a resurrection bug (two instances surviving a
+  healed partition) fails here.
+- **Counter exactly-once**: every client op carries a fresh op id; the
+  counter actor durably applies it (GCS KV) before acking. At the end:
+  every *acked* op is applied exactly once (no lost increments, no
+  double-application across restarts/fencing), and every applied op was
+  actually attempted (no invented writes). Ops that *errored* at the
+  client may be applied or not (indeterminate) — but never twice.
+- **No wedged gets**: a background task workload must finish (or raise a
+  typed error) within a bound; a ``get()`` that outlives it is a wedge.
+- **Trainer consistency** (``--trainer``): an elastic ``JaxTrainer.fit``
+  survives the campaign and its cumulative history equals a fault-free
+  golden run — every step exactly once, no gaps, no repeats.
+
+Events (worker nodes only; the head node hosting the driver is spared):
+
+- ``partition_gcs``: isolate one node's raylet from the GCS (the zombie
+  scenario: the node keeps running, the GCS declares it dead, heal-time
+  RPCs get fenced), symmetric or one-way, self-healing after a few
+  seconds.
+- ``heal``: heal the oldest active partition early.
+- ``kill``: SIGKILL a node's raylet and register a replacement.
+- ``preempt``: a drain notice (report_preemption) with a short deadline.
+
+Usage::
+
+    python -m tools.chaos_soak --seed 7 --duration 60 --nodes 2 [--trainer]
+
+``tests/test_partition.py`` runs a bounded variant of this campaign in
+tier-1 with ``RAY_TPU_CHAOS_SEED`` pinned.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Set
+
+KV_PREFIX = "soakctr/"
+COUNTER_NAME = "soak_counter"
+
+
+def _define_counter(rt):
+    @rt.remote(max_restarts=-1, resources={"soak_slot": 0.01})
+    class SoakCounter:
+        """Increments are durable (GCS KV) BEFORE they are acked: an ack
+        the client records implies a KV key exists — the 'applied' set
+        the invariant checker audits. The key embeds the instance pid +
+        a nonce so the same op applied twice (a double-execution bug)
+        shows up as two keys under one op id."""
+
+        def __init__(self):
+            self.n = 0
+
+        def incr(self, op_id: str) -> int:
+            import os as _os
+            import uuid as _uuid
+
+            from ray_tpu.core.runtime_base import current_runtime
+
+            gcs = current_runtime()._gcs
+            gcs.call(
+                "kv_put",
+                f"{KV_PREFIX}{op_id}/{_os.getpid()}-{_uuid.uuid4().hex[:6]}",
+                b"1",
+            )
+            self.n += 1
+            return self.n
+
+        def whereami(self) -> int:
+            import os as _os
+
+            return _os.getpid()
+
+    return SoakCounter
+
+
+class SoakResult:
+    def __init__(self):
+        self.ops_acked: Set[str] = set()
+        self.ops_errored: Set[str] = set()
+        self.events: List[Dict[str, Any]] = []
+        self.violations: List[str] = []
+        self.task_rounds = 0
+        self.fenced_total = 0.0
+        self.trainer_ok: Optional[bool] = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def summary(self) -> str:
+        ev = {}
+        for e in self.events:
+            ev[e["kind"]] = ev.get(e["kind"], 0) + 1
+        return (
+            f"events={ev} acked={len(self.ops_acked)} "
+            f"errored={len(self.ops_errored)} task_rounds={self.task_rounds} "
+            f"fenced={self.fenced_total:.0f} trainer_ok={self.trainer_ok} "
+            f"violations={self.violations or 'none'}"
+        )
+
+
+def _golden_trajectory(n_steps: int):
+    w = 1.0
+    out = []
+    for step in range(n_steps):
+        w = w * 0.9 + 0.1
+        out.append((step, round(w, 12)))
+    return out
+
+
+def _deterministic_train_loop(n_steps: int, step_sleep: float = 0.05):
+    def loop(config):
+        from ray_tpu import train
+
+        w, start, history = 1.0, 0, []
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            d = ckpt.to_dict()
+            start, w, history = d["step"] + 1, d["w"], list(d["history"])
+        for step in range(start, n_steps):
+            w = w * 0.9 + 0.1
+            history.append((step, round(w, 12)))
+            train.report(
+                {"loss": w, "step": step},
+                checkpoint=train.Checkpoint.from_dict(
+                    {"step": step, "w": w, "history": history}
+                ),
+            )
+            if train.drain_requested():
+                return
+            time.sleep(step_sleep)
+
+    return loop
+
+
+class SoakCampaign:
+    """One seeded campaign against a cluster this object boots and owns."""
+
+    def __init__(
+        self,
+        seed: int,
+        duration_s: float,
+        *,
+        nodes: int = 2,
+        cpus_per_node: float = 2.0,
+        event_period_s: float = 1.5,
+        use_trainer: bool = False,
+        trainer_steps: int = 20,
+        storage_path: Optional[str] = None,
+    ):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.duration_s = duration_s
+        self.nodes = nodes
+        self.cpus_per_node = cpus_per_node
+        self.event_period_s = event_period_s
+        self.use_trainer = use_trainer
+        self.trainer_steps = trainer_steps
+        self.storage_path = storage_path
+        self.result = SoakResult()
+        self._stop = threading.Event()
+        self._partitions: List[Any] = []
+        self._workers: List[str] = []  # alive worker node ids
+
+    # ----------------------------------------------------------- lifecycle
+    def run(self) -> SoakResult:
+        # Short membership clocks so a 60 s campaign sees many full
+        # partition->dead->heal->fence->rejoin cycles. The env reaches
+        # the daemons (spawned below); seeded chaos replays exactly.
+        os.environ.setdefault("RAY_TPU_HEARTBEAT_INTERVAL_S", "0.25")
+        os.environ.setdefault("RAY_TPU_HEARTBEAT_TIMEOUT_S", "1.5")
+        os.environ["RAY_TPU_CHAOS_SEED"] = str(self.seed)
+
+        import ray_tpu as rt
+        from ray_tpu.core import runtime_base
+        from ray_tpu.core.cluster_runtime import Cluster
+
+        self.rt = rt
+        rt.shutdown()
+        self.cluster = Cluster(num_cpus=self.cpus_per_node)
+        self.runtime = self.cluster.runtime()
+        runtime_base.set_runtime(self.runtime)
+        self.gcs = self.runtime._gcs
+        try:
+            res = {"soak_slot": 4.0, "train_slot": 1.0}
+            for _ in range(self.nodes):
+                self._workers.append(
+                    self.cluster.add_node(
+                        num_cpus=self.cpus_per_node, resources=dict(res)
+                    )
+                )
+            counter_cls = _define_counter(rt)
+            self.counter = counter_cls.options(name=COUNTER_NAME).remote()
+            rt.get(self.counter.whereami.remote(), timeout=30)
+
+            threads = [
+                threading.Thread(target=self._counter_client, daemon=True),
+                threading.Thread(target=self._task_client, daemon=True),
+            ]
+            trainer_thread = None
+            if self.use_trainer:
+                trainer_thread = threading.Thread(
+                    target=self._trainer, daemon=True
+                )
+                threads.append(trainer_thread)
+            for t in threads:
+                t.start()
+
+            deadline = time.monotonic() + self.duration_s
+            while time.monotonic() < deadline:
+                self._one_event()
+                self._check_singleton_record()
+                time.sleep(self.event_period_s * self.rng.uniform(0.6, 1.4))
+
+            # Quiesce: heal everything, let fences/rejoins/restarts settle.
+            for p in self._partitions:
+                p.heal()
+            self._stop.set()
+            for t in threads:
+                t.join(timeout=90)
+                if t.is_alive():
+                    self.result.violations.append(
+                        f"workload thread {t.name} wedged past quiesce join"
+                    )
+            self._final_checks()
+        finally:
+            self._stop.set()
+            for p in self._partitions:
+                try:
+                    p.heal()
+                except Exception:  # lint: swallow-ok(teardown heal; deadline self-heal covers it)
+                    pass
+            rt.shutdown()
+        return self.result
+
+    # ------------------------------------------------------------ workloads
+    def _counter_client(self) -> None:
+        rt = self.rt
+        while not self._stop.is_set():
+            op_id = uuid.uuid4().hex[:12]
+            try:
+                rt.get(self.counter.incr.remote(op_id), timeout=30)
+                self.result.ops_acked.add(op_id)
+            except Exception:
+                # Indeterminate: may or may not have applied — allowed,
+                # but never applied twice (checked at the end).
+                self.result.ops_errored.add(op_id)
+                time.sleep(0.2)
+            time.sleep(0.05)
+
+    def _task_client(self) -> None:
+        rt = self.rt
+
+        @rt.remote
+        def _probe(x):
+            return x * 2
+
+        while not self._stop.is_set():
+            t0 = time.monotonic()
+            try:
+                assert rt.get(_probe.remote(21), timeout=60) == 42
+                self.result.task_rounds += 1
+            except Exception as e:
+                if time.monotonic() - t0 >= 59:
+                    self.result.violations.append(
+                        f"task get wedged >60s: {type(e).__name__}"
+                    )
+            time.sleep(0.1)
+
+    def _trainer(self) -> None:
+        from ray_tpu.train import (
+            FailureConfig,
+            JaxTrainer,
+            RunConfig,
+            ScalingConfig,
+        )
+
+        import tempfile
+
+        storage = self.storage_path or tempfile.mkdtemp(prefix="soak_exp_")
+        trainer = JaxTrainer(
+            _deterministic_train_loop(self.trainer_steps),
+            scaling_config=ScalingConfig(
+                num_workers=1,
+                resources_per_worker={"train_slot": 1.0},
+                elastic=True,
+                min_workers=1,
+            ),
+            run_config=RunConfig(
+                name=f"soak_{self.seed}",
+                storage_path=storage,
+                failure_config=FailureConfig(max_failures=8),
+            ),
+        )
+        try:
+            result = trainer.fit()
+            if result.error is not None or result.checkpoint is None:
+                self.result.trainer_ok = False
+                self.result.violations.append(
+                    f"trainer did not recover: {result.error!r}"
+                )
+                return
+            history = [tuple(x) for x in result.checkpoint.to_dict()["history"]]
+            golden = _golden_trajectory(self.trainer_steps)
+            self.result.trainer_ok = history == golden
+            if not self.result.trainer_ok:
+                self.result.violations.append(
+                    "trainer loss trajectory diverged from the fault-free "
+                    f"golden run (got {len(history)} steps)"
+                )
+        except Exception as e:  # noqa: BLE001
+            self.result.trainer_ok = False
+            self.result.violations.append(f"trainer raised: {e!r}")
+
+    # -------------------------------------------------------------- events
+    def _alive_workers(self) -> List[str]:
+        alive = {
+            n["NodeID"] for n in self.gcs.call("list_nodes") if n["Alive"]
+        }
+        return [w for w in self._workers if w in alive]
+
+    def _one_event(self) -> None:
+        from ray_tpu import chaos
+
+        kinds = ["partition_gcs", "partition_gcs", "heal", "kill", "preempt"]
+        kind = self.rng.choice(kinds)
+        candidates = self._alive_workers()
+        rec: Dict[str, Any] = {"kind": kind, "ts": time.time()}
+        try:
+            if kind == "partition_gcs" and candidates:
+                victim = self.rng.choice(candidates)
+                one_way = self.rng.random() < 0.3
+                p = chaos.partition(
+                    [[victim], ["gcs"]],
+                    one_way=one_way,
+                    heal_after=self.rng.uniform(3.0, 6.0),
+                    runtime=self.runtime,
+                )
+                self._partitions.append(p)
+                rec.update(node=victim[:8], one_way=one_way)
+            elif kind == "heal":
+                live = [p for p in self._partitions if not p.healed]
+                if live:
+                    live[0].heal()
+                    rec.update(spec=live[0].spec_id)
+                else:
+                    rec["kind"] = "noop"
+            elif kind == "kill" and len(candidates) >= 2:
+                victim = self.rng.choice(candidates)
+                self.cluster.remove_node(victim)
+                self._workers.remove(victim)
+                self._workers.append(
+                    self.cluster.add_node(
+                        num_cpus=self.cpus_per_node,
+                        resources={"soak_slot": 4.0, "train_slot": 1.0},
+                    )
+                )
+                rec.update(node=victim[:8])
+            elif kind == "preempt" and candidates:
+                victim = self.rng.choice(candidates)
+                self.gcs.call(
+                    "report_preemption", victim, self.rng.uniform(1.0, 3.0),
+                    "soak preempt",
+                )
+                rec.update(node=victim[:8])
+            else:
+                rec["kind"] = "noop"
+        except Exception as e:  # noqa: BLE001
+            rec.update(error=repr(e))
+        self.result.events.append(rec)
+
+    # ----------------------------------------------------------- invariants
+    def _check_singleton_record(self) -> None:
+        try:
+            actors = self.gcs.call("list_actors", 100_000)
+        except Exception:
+            return
+        alive = [
+            a
+            for a in actors
+            if a.get("name") == COUNTER_NAME and a["state"] == "ALIVE"
+        ]
+        if len(alive) > 1:
+            self.result.violations.append(
+                f"{len(alive)} ALIVE records for named actor {COUNTER_NAME!r}"
+            )
+
+    def _final_checks(self) -> None:
+        rt = self.rt
+        # The counter must be reachable and singular after the storm.
+        pid = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                pid = rt.get(self.counter.whereami.remote(), timeout=15)
+                break
+            except Exception:
+                time.sleep(0.5)
+        if pid is None:
+            self.result.violations.append(
+                "named counter unreachable after quiesce"
+            )
+        self._check_singleton_record()
+
+        # Exactly-once audit against the durable applied set.
+        applied: Dict[str, int] = {}
+        try:
+            for key in self.gcs.call("kv_keys", KV_PREFIX):
+                op_id = key[len(KV_PREFIX):].split("/", 1)[0]
+                applied[op_id] = applied.get(op_id, 0) + 1
+        except Exception as e:  # noqa: BLE001
+            self.result.violations.append(f"could not audit KV: {e!r}")
+            return
+        attempted = self.result.ops_acked | self.result.ops_errored
+        lost = [op for op in self.result.ops_acked if applied.get(op, 0) == 0]
+        duped = sorted(op for op, n in applied.items() if n > 1)
+        phantom = [op for op in applied if op not in attempted]
+        if lost:
+            self.result.violations.append(
+                f"{len(lost)} acked increment(s) lost (e.g. {lost[:3]})"
+            )
+        if duped:
+            self.result.violations.append(
+                f"{len(duped)} op(s) applied more than once (e.g. {duped[:3]})"
+            )
+        if phantom:
+            self.result.violations.append(
+                f"{len(phantom)} applied op(s) never attempted"
+            )
+
+        # Fence accounting (informational; campaigns with partitions that
+        # outlive the heartbeat window should see >= 1).
+        try:
+            from ray_tpu.utils import state
+
+            self.result.fenced_total = sum(
+                m["value"]
+                for m in state.internal_metrics()
+                if m["name"] == "raytpu_nodes_fenced_total"
+            )
+        except Exception:  # lint: swallow-ok(informational counter read at teardown)
+            pass
+
+
+def run_soak(seed: int, duration_s: float, **kwargs) -> SoakResult:
+    return SoakCampaign(seed, duration_s, **kwargs).run()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--seed", type=int, default=int(os.environ.get("RAY_TPU_CHAOS_SEED", "0") or 0))
+    ap.add_argument("--duration", type=float, default=60.0)
+    ap.add_argument("--nodes", type=int, default=2)
+    ap.add_argument("--event-period", type=float, default=1.5)
+    ap.add_argument("--trainer", action="store_true")
+    args = ap.parse_args()
+    result = run_soak(
+        args.seed,
+        args.duration,
+        nodes=args.nodes,
+        event_period_s=args.event_period,
+        use_trainer=args.trainer,
+    )
+    print(f"soak[{args.seed}]: {result.summary()}")  # console-output: CLI report
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -69,6 +69,9 @@ REQUIRED = {
     # a backend init here would wedge the cluster with chaos DISARMED.
     "ray_tpu.chaos",
     "ray_tpu.chaos.controller",
+    # The partition layer imports into core/rpc.py — i.e. every process
+    # that owns an RpcClient (all of them).
+    "ray_tpu.chaos.net",
     "ray_tpu.utils.node_events",
     # The elastic-training modules import into every training worker
     # (ray_tpu.train re-exports them) and the cgraph elastic wrapper
