@@ -505,6 +505,52 @@ def bench_lock_order_overhead_guard(min_time: float) -> None:
     )
 
 
+def bench_pool_overhead_guard(min_time: float) -> None:
+    """Warm-pool maintenance overhead guard.
+
+    The pool manager's standing loop (zygote liveness checks, refill
+    sizing, gauges) plus the per-dispatch hit/miss accounting run on
+    every node — the shipped default (RAY_TPU_WORKER_POOL=1) must cost
+    <2% of steady-state no-op task throughput vs the pool disabled.
+    Interleaved off/on boots with best-of per config (the logging/
+    lock-order guards' protocol): boot-to-boot drift on a shared box
+    dwarfs a 2% budget."""
+    import os
+
+    saved = os.environ.get("RAY_TPU_WORKER_POOL")
+    rates = {"off": 0.0, "on": 0.0}
+    try:
+        for _trial in range(3):
+            for label, flag in (("off", "0"), ("on", "1")):
+                os.environ["RAY_TPU_WORKER_POOL"] = flag
+                rt.init(num_cpus=8, num_workers=2, object_store_memory=256 << 20)
+                rates[label] = max(rates[label], _sync_dispatch_rate(min_time))
+                rt.shutdown()
+    finally:
+        if saved is None:
+            os.environ.pop("RAY_TPU_WORKER_POOL", None)
+        else:
+            os.environ["RAY_TPU_WORKER_POOL"] = saved
+    ratio = rates["on"] / rates["off"] if rates["off"] else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "worker_pool_overhead",
+                "value": round(ratio, 3),
+                "unit": "x (pool maintenance armed/disabled sync dispatch)",
+                "vs_baseline": None,
+                "on_ops_s": round(rates["on"], 1),
+                "off_ops_s": round(rates["off"], 1),
+            }
+        ),
+        flush=True,
+    )
+    assert ratio >= 0.98, (
+        f"worker-pool maintenance cost {100 * (1 - ratio):.1f}% of no-op "
+        f"dispatch (budget: 2%) — {rates}"
+    )
+
+
 def bench_chaos_overhead_guard(min_time: float) -> None:
     """Chaos injection-point overhead guard.
 
@@ -1069,6 +1115,7 @@ def main():
     bench_history_watchdog_overhead_guard(min_time)
     bench_logging_overhead_guard(min_time)
     bench_lock_order_overhead_guard(min_time)
+    bench_pool_overhead_guard(min_time)
     # Very last (it asserts the >=2x ZeRO shrink contract): a failure here
     # must not mask the overhead guards above.
     bench_elastic()

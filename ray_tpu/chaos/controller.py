@@ -85,6 +85,12 @@ POINT_ACTIONS = {
     # sites via chaos/net.py.
     "net.call": ("drop", "delay", "raise"),   # RpcClient.call/notify, by addr|method
     "net.connect": ("drop", "raise"),         # RpcClient._new_sock, by addr
+    # Worker-pool zygote spawn path (core/worker_pool.py). `kill`
+    # SIGKILLs the zygote DAEMON at a spawn request (not the raylet) —
+    # the daemon-death-strands-the-pool failure mode: the pool manager
+    # must detect it, respawn the zygote, and rebuild the parked pool
+    # while the in-flight spawn falls back to a cold Popen.
+    "zygote.spawn": ("kill", "raise", "delay"),
 }
 POINTS = tuple(POINT_ACTIONS)
 

@@ -109,6 +109,73 @@ def _submit_span(entry: dict):
     )
 
 
+class _ActorCreateBatcher:
+    """Coalescing leader-follower batcher over the GCS `create_actors`
+    RPC. A serial caller flushes immediately (batch of 1 — no artificial
+    coalescing delay), but while any batch RPC is IN FLIGHT, concurrent
+    creators queue behind it and whoever is waiting when it returns
+    leads the next RPC with the whole accumulated batch — a creation
+    storm from N threads pipelines into O(RPCs in flight) GCS round
+    trips instead of N (reference: the submission-queue coalescing in
+    NormalTaskSubmitter, applied to actor registration)."""
+
+    def __init__(self, gcs: RpcClient):
+        self._gcs = gcs
+        self._cv = threading.Condition()
+        self._queue: List[dict] = []
+        self._inflight = False
+
+    def create(self, spec: dict) -> dict:
+        item = {"spec": spec, "done": False, "result": None}
+        batch: Optional[List[dict]] = None
+        with self._cv:
+            self._queue.append(item)
+            while not item["done"]:
+                if not self._inflight and self._queue:
+                    batch, self._queue = self._queue, []
+                    self._inflight = True
+                    break
+                self._cv.wait()
+        if batch is not None:
+            results = None
+            try:
+                results = self._gcs.call(
+                    "create_actors", [it["spec"] for it in batch]
+                )
+                if not isinstance(results, list) or len(results) != len(batch):
+                    raise RuntimeError(
+                        f"create_actors: malformed batch reply ({results!r:.120})"
+                    )
+            except Exception as e:  # noqa: BLE001
+                results = [{"error": e}] * len(batch)
+            finally:
+                # Always release leadership — a BaseException escaping
+                # the RPC (KeyboardInterrupt) must not strand followers
+                # waiting on a leader that will never return.
+                with self._cv:
+                    if results is None:
+                        interrupted = RuntimeError(
+                            "create_actors batch interrupted"
+                        )
+                        results = [{"error": interrupted}] * len(batch)
+                    for it, r in zip(batch, results):
+                        it["result"] = r
+                        it["done"] = True
+                    self._inflight = False
+                    self._cv.notify_all()
+        result = item["result"]
+        err = result.get("error")
+        if err is not None:
+            # Per-spec failures travel as pickled exception objects —
+            # re-raised here so the caller sees the same typed error
+            # (ActorNameTakenError, SchedulingError, ...) the old
+            # two-RPC path raised.
+            if isinstance(err, BaseException):
+                raise err
+            raise RuntimeError(str(err))
+        return result
+
+
 class _TaskRecord:
     """Owner-side record of a submitted task: the wire entry kept for retry
     and lineage reconstruction until the last reference to its outputs drops
@@ -158,6 +225,10 @@ class ClusterRuntime(Runtime):
         _frec.install_crash_hooks("driver" if driver else "worker")
         self._actor_location: Dict[str, str] = {}  # actor_id -> raylet sock
         self._raylet_clients: Dict[str, RpcClient] = {}
+        # Actor creations coalesce through a leader-follower batcher
+        # over the GCS's batched create_actors RPC (register + place +
+        # forward in one round trip).
+        self._actor_batcher = _ActorCreateBatcher(gcs)
         self._shutdown_done = False
         # Owner-side reference counting + task records (reference:
         # reference_count.h:64, task_manager.h:208). return-oid hex ->
@@ -1101,39 +1172,48 @@ class ClusterRuntime(Runtime):
                     self._local_refs[dep] = self._local_refs.get(dep, 0) + 1
             entry["actor_id"] = actor_id.hex()
             blob = pickle.dumps(entry)
-            with _tracing.span("actor_launch.gcs_register"):
-                node = self._gcs.call(
-                    "register_actor",
-                    actor_id.hex(),
-                    blob,
-                    # Placement bias (reference: actors use 1 CPU for
-                    # SCHEDULING, 0 while alive): a DEFAULT actor holds
-                    # nothing at runtime (entry["resources"] is empty) but
-                    # is PLACED as if it cost a CPU, so utility-actor swarms
-                    # spread instead of piling onto the most-utilized node.
-                    # An EXPLICIT num_cpus=0 actor skips the bias — it must
-                    # place on CPU-less custom-resource hosts.
-                    entry["resources"]
-                    or ({"CPU": 1.0} if spec.options.actor_placement_bias else {}),
-                    spec.options.max_restarts,
-                    spec.options.name,
-                    spec.options.namespace,
-                    spec.options.placement_group_id,
-                    spec.options.bundle_index,
-                    spec.options.scheduling_strategy,
-                )
+            # Register + place + forward collapse into ONE GCS round trip
+            # (batched: the GCS groups a storm's forwards per raylet into
+            # create_actor_batch calls) — the old path paid a second,
+            # serial driver->raylet RPC per actor. The span keeps the
+            # historical gcs_register name so launch-breakdown tooling
+            # (bench_scale actor_launch_breakdown, ray-tpu timeline)
+            # reads old and new traces uniformly; it now covers the
+            # whole registration+submit leg.
             with _tracing.span(
-                "actor_launch.submit",
+                "actor_launch.gcs_register",
                 {
-                    "node_id": node.get("node_id", ""),
                     # Tail of the launch flow arrow; the raylet's
                     # worker_spawn and the worker's init report the same
-                    # id as flow_in, chaining submit->spawn->init.
+                    # id as flow_in, chaining register->spawn->init.
                     "flow_out": (entry.get("trace_ctx") or {}).get("flow"),
                 },
             ):
-                self._raylet_for(node["sock"]).call(
-                    "create_actor", blob, True, node.get("bundle_index")
+                node = self._actor_batcher.create(
+                    {
+                        "actor_id": actor_id.hex(),
+                        "spec_blob": blob,
+                        # Placement bias (reference: actors use 1 CPU for
+                        # SCHEDULING, 0 while alive): a DEFAULT actor holds
+                        # nothing at runtime (entry["resources"] is empty)
+                        # but is PLACED as if it cost a CPU, so
+                        # utility-actor swarms spread instead of piling
+                        # onto the most-utilized node. An EXPLICIT
+                        # num_cpus=0 actor skips the bias — it must place
+                        # on CPU-less custom-resource hosts.
+                        "resources": entry["resources"]
+                        or (
+                            {"CPU": 1.0}
+                            if spec.options.actor_placement_bias
+                            else {}
+                        ),
+                        "max_restarts": spec.options.max_restarts,
+                        "name": spec.options.name,
+                        "namespace": spec.options.namespace,
+                        "pg_id": spec.options.placement_group_id,
+                        "bundle_index": spec.options.bundle_index,
+                        "strategy": spec.options.scheduling_strategy,
+                    }
                 )
         self._actor_location[actor_id.hex()] = node["sock"]
         return actor_id

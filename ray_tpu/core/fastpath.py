@@ -483,6 +483,23 @@ class ActorChannel:
     def _connect_loop(self) -> None:
         """Resolves the actor's direct socket, then drains the buffer over
         it IN ORDER before any new submit can race ahead."""
+        # Known-location shortcut: the create reply already named the
+        # hosting raylet, so the first resolution asks IT directly —
+        # skipping the GCS get_actor round trip per channel (a launch
+        # storm's first-call wave otherwise serializes on the GCS).
+        # Any miss (no entry, not ALIVE there yet, moved) falls through
+        # to the authoritative GCS loop below.
+        known = self._rt._actor_location.get(self.aid)
+        if known and parse_address(known)[0] == "uds":
+            try:
+                dsock = self._rt._raylet_for(known).call(
+                    "actor_direct_sock", self.aid
+                )
+            except Exception:
+                dsock = None
+            if dsock and os.path.exists(dsock):
+                if self._adopt_conn(dsock):
+                    return
         while True:
             try:
                 info = self._rt._gcs.call("get_actor", self.aid)
@@ -510,46 +527,54 @@ class ActorChannel:
                 except Exception:
                     dsock = None
                 if dsock and os.path.exists(dsock):
-                    try:
-                        # Short per-attempt timeout: right after a worker
-                        # death this dsock can be the DEAD incarnation's
-                        # still-on-disk socket (the GCS/raylet records go
-                        # stale for one monitor tick), and a long blind
-                        # connect burns the whole window refusing. The
-                        # loop re-resolves fresh state each pass, so a
-                        # legitimately slow boot just reconnects next
-                        # round (measured: actor restore 7 s -> 2.5 s).
-                        conn = DirectConn(
-                            dsock,
-                            f"actor-{self.aid[:8]}",
-                            self._on_conn_dead,
-                            connect_timeout=1.0,
-                            on_sealed=self._rt._fast_sealed,
-                        )
-                    except ConnectionError:
-                        time.sleep(0.1)
-                        continue
-                    with self._lock:
-                        buf, self._buffer = self._buffer, []
-                        failed_at = None
-                        for i, e in enumerate(buf):
-                            self._rt._fast_register(e)
-                            try:
-                                conn.send(actor_frame(e), e)
-                            except OSError:
-                                self._rt._fast_sealed(e["return_ids"])
-                                failed_at = i
-                                break
-                        if failed_at is None:
-                            self._conn = conn
-                            self._state = "DIRECT"
-                            return
-                        # Worker died during the flush: conn._die() fails
-                        # what was sent; re-buffer the rest and retry.
-                        self._buffer = buf[failed_at:] + self._buffer
+                    if self._adopt_conn(dsock):
+                        return
                     time.sleep(0.1)
                     continue
             time.sleep(0.05)
+
+    def _adopt_conn(self, dsock: str) -> bool:
+        """Connects to a resolved direct socket and drains the buffer
+        over it IN ORDER; True once the channel is DIRECT. False =
+        connect refused or the worker died mid-drain (caller re-resolves
+        fresh state and retries)."""
+        try:
+            # Short per-attempt timeout: right after a worker
+            # death this dsock can be the DEAD incarnation's
+            # still-on-disk socket (the GCS/raylet records go
+            # stale for one monitor tick), and a long blind
+            # connect burns the whole window refusing. The
+            # caller re-resolves fresh state each pass, so a
+            # legitimately slow boot just reconnects next
+            # round (measured: actor restore 7 s -> 2.5 s).
+            conn = DirectConn(
+                dsock,
+                f"actor-{self.aid[:8]}",
+                self._on_conn_dead,
+                connect_timeout=1.0,
+                on_sealed=self._rt._fast_sealed,
+            )
+        except ConnectionError:
+            return False
+        with self._lock:
+            buf, self._buffer = self._buffer, []
+            failed_at = None
+            for i, e in enumerate(buf):
+                self._rt._fast_register(e)
+                try:
+                    conn.send(actor_frame(e), e)
+                except OSError:
+                    self._rt._fast_sealed(e["return_ids"])
+                    failed_at = i
+                    break
+            if failed_at is None:
+                self._conn = conn
+                self._state = "DIRECT"
+                return True
+            # Worker died during the flush: conn._die() fails
+            # what was sent; re-buffer the rest and retry.
+            self._buffer = buf[failed_at:] + self._buffer
+        return False
 
     def _to_slow(self) -> None:
         with self._lock:

@@ -85,6 +85,11 @@ class GcsService(ChaosPartitionRpc):
         # that actor's restart path forever.
         self._actor_restarting: Set[str] = set()
         self._stranded_sweep_inflight = False  # one sweep thread at a time
+        # Autoscaler demand forecast (autoscaler_v2 InstanceManager
+        # relays its pending-work estimate): folded into each heartbeat
+        # reply's pool_hint so raylets pre-size their warm worker pools
+        # BEFORE the launch storm arrives. (value, expires_at_monotonic).
+        self._demand_forecast: Tuple[int, float] = (0, 0.0)
         self._borrows: Dict[str, int] = {}
         self._deferred_free: Set[str] = set()
         self._free_queue: List[Tuple[float, List[str]]] = []
@@ -449,6 +454,17 @@ class GcsService(ChaosPartitionRpc):
             alive = sum(1 for m in self._nodes.values() if m["alive"])
             if n is None:
                 return {"ok": False, "nodes": alive}
+            # Warm-pool demand hint: this node's share of the
+            # autoscaler's pending-work forecast — launches expected but
+            # NOT yet registered (registration consumes the forecast).
+            # Deliberately excludes already-registered PENDING actors:
+            # those are consuming the pool right now, the raylet's local
+            # launch-rate EWMA already sees them, and counting them here
+            # double-inflated the target right as the storm peaked.
+            fc_n, fc_exp = self._demand_forecast
+            pool_hint = 0
+            if fc_n > 0 and time.monotonic() < fc_exp and alive > 0:
+                pool_hint = -(-fc_n // alive)  # ceil division
             # Verdict and update under ONE lock acquisition: judging here
             # and re-deriving inside _reject_stale_node left a window
             # where a concurrent re-registration flipped the record
@@ -476,7 +492,20 @@ class GcsService(ChaosPartitionRpc):
             # subscriber notification, persistence, and the drained
             # counter all fire identically.
             self.report_preemption(node_id, 0.0, "raylet-initiated drain")
-        return {"ok": True, "nodes": alive}
+        return {"ok": True, "nodes": alive, "pool_hint": pool_hint}
+
+    def report_demand_forecast(self, n: int, ttl_s: float = 15.0) -> bool:
+        """Autoscaler-relayed pending-work forecast (actors expected to
+        launch cluster-wide soon). TTL-bounded: a crashed autoscaler's
+        stale forecast must decay instead of pinning every pool high
+        forever. Each heartbeat reply hands every raylet
+        ceil(n / alive_nodes) as its pool_hint share."""
+        with self._lock:
+            self._demand_forecast = (
+                max(0, int(n)),
+                time.monotonic() + max(0.0, float(ttl_s)),
+            )
+        return True
 
     # ---------------------------------------------------- preemption/drain
     def report_preemption(
@@ -1219,6 +1248,15 @@ class GcsService(ChaosPartitionRpc):
                         del self._named[key]
             raise
         with self._lock:
+            # Each registration CONSUMES one unit of the autoscaler's
+            # pending-work forecast: the forecast predicts launches that
+            # haven't arrived yet, so once they do, the pools must stop
+            # holding capacity for them (an unconsumed forecast kept
+            # refilling — and CPU-starving — the node straight through
+            # the launch storm it predicted).
+            fc_n, fc_exp = self._demand_forecast
+            if fc_n > 0:
+                self._demand_forecast = (fc_n - 1, fc_exp)
             self._actors[actor_id] = {
                 "state": "PENDING",
                 "node_id": node["node_id"],
@@ -1237,6 +1275,66 @@ class GcsService(ChaosPartitionRpc):
             if key is not None:
                 self._persist_delta("_named", key, actor_id)
         return node
+
+    def create_actors(self, specs: List[dict]) -> List[dict]:
+        """Batched register+place+forward: ONE driver RPC registers a
+        storm of actors and the GCS itself forwards the creations,
+        grouped per target raylet into `create_actor_batch` calls — the
+        control plane serializes on O(batches), not O(actors), and the
+        driver's old two-round-trip create (register_actor + raylet
+        create_actor) collapses to one. Per-spec failures return as the
+        exception OBJECT in that spec's slot (re-raised driver-side);
+        one bad spec cannot fail its batch-mates. Forward replays are
+        safe: the raylet's create path is idempotent (PR 14)."""
+        results: List[dict] = []
+        by_sock: Dict[str, List[Tuple[int, bytes, int]]] = {}
+        for i, s in enumerate(specs):
+            try:
+                node = self.register_actor(
+                    s["actor_id"],
+                    s["spec_blob"],
+                    s.get("resources") or {},
+                    s.get("max_restarts", 0),
+                    s.get("name"),
+                    s.get("namespace"),
+                    s.get("pg_id"),
+                    s.get("bundle_index", -1),
+                    s.get("strategy", "DEFAULT"),
+                )
+            except Exception as e:  # noqa: BLE001
+                results.append({"error": e})
+                continue
+            bi = node.get("bundle_index", -1)
+            results.append(
+                {"node_id": node["node_id"], "sock": node["sock"], "bundle_index": bi}
+            )
+            by_sock.setdefault(node["sock"], []).append((i, s["spec_blob"], bi))
+        for sock, items in by_sock.items():
+            try:
+                self._raylet_call(
+                    sock, "create_actor_batch", [(blob, bi) for _, blob, bi in items]
+                )
+            except Exception as e:  # noqa: BLE001
+                # The chosen raylet is unreachable: surface the failure
+                # to the driver (matching the old direct-forward path's
+                # raise) and free the registration — a PENDING record
+                # pinned to a node that never hosted it would wedge
+                # name lookups forever.
+                _log.warning(
+                    "create_actor_batch forward to %s failed: %r", sock, e
+                )
+                with self._lock:
+                    for i, _, _ in items:
+                        aid = specs[i]["actor_id"]
+                        a = self._actors.get(aid)
+                        if a is not None and a["state"] == "PENDING":
+                            a["state"] = "DEAD"
+                            a["death_reason"] = f"creation forward failed: {e!r}"
+                            a["node_id"] = None
+                            self._drop_name(aid)
+                            self._persist_delta("_actors", aid, a)
+                        results[i] = {"error": e}
+        return results
 
     def actor_started(
         self, actor_id: str, node_id: str, epoch: Optional[int] = None
@@ -1260,6 +1358,31 @@ class GcsService(ChaosPartitionRpc):
                 a["node_id"] = node_id
                 self._persist_delta("_actors", actor_id, a)
         return True
+
+    def actor_started_batch(
+        self, node_id: str, actor_ids: List[str], epoch: Optional[int] = None
+    ) -> Dict[str, bool]:
+        """Coalesced actor_started reports from one raylet's launch
+        storm: the fence is judged ONCE per batch (all entries carry the
+        same incarnation's epoch) and the per-actor verdicts follow the
+        single-report semantics — False tells the raylet that instance
+        is a duplicate to kill locally."""
+        self._reject_stale_node(node_id, epoch, "actor_started_batch")
+        out: Dict[str, bool] = {}
+        with self._lock:
+            for actor_id in actor_ids:
+                a = self._actors.get(actor_id)
+                if a and (
+                    a["state"] == "DEAD" or a.get("node_id") not in (None, node_id)
+                ):
+                    out[actor_id] = False
+                    continue
+                if a:
+                    a["state"] = "ALIVE"
+                    a["node_id"] = node_id
+                    self._persist_delta("_actors", actor_id, a)
+                out[actor_id] = True
+        return out
 
     def actor_died(
         self,

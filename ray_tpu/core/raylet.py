@@ -71,6 +71,10 @@ class _Worker:
         # worker_pool.h PopWorker matching runtime_env_hash)
         self.last_done: Optional[str] = None  # idempotency: a retried
         # worker_step must not double-apply its completion report
+        self.ready = False  # first poll arrived: the process finished
+        # booting (a forked-but-still-booting worker sits in the idle
+        # pool — adoptable, its mailbox buffers the entry — but only a
+        # ready worker counts as WARM for pool-health reporting)
 
 
 class RayletService(ChaosPartitionRpc):
@@ -209,14 +213,18 @@ class RayletService(ChaosPartitionRpc):
         # from "same healthy incarnation, new number" (resend).
         self._max_fenced_epoch = 0
 
-        # Worker zygote: a pre-warmed single-threaded forker that cuts the
+        # Worker warm pool + zygote lifecycle (core/worker_pool.py): a
+        # pre-warmed single-threaded forker (core/zygote.py) cuts the
         # ~2 s interpreter+jax startup of every fresh worker to a ~10 ms
-        # fork (core/zygote.py; reference: worker_pool.h prestart). Booted
-        # lazily off-thread so raylet startup never waits on it; until
-        # ready (or if disabled/dead) spawns take the normal Popen path.
-        # The thread starts at the END of __init__ (it reads _log_dir).
-        self._zygote_proc: Optional[subprocess.Popen] = None
-        self._zygote: Optional[Any] = None
+        # fork, and the pool manager keeps BOTH warm tiers topped up — a
+        # live idle-worker pool (popped at dispatch in microseconds) and
+        # the zygote's parked pre-forks (a miss costs a ~1-2 ms pipe
+        # assignment instead of the fork) — sized by launch-rate EWMA +
+        # the GCS demand hint. Constructed after _log_dir below; started
+        # at the END of __init__. Until the zygote is ready (or if
+        # disabled/dead) spawns take the normal Popen path; a dead
+        # zygote daemon is respawned by the manager, not abandoned.
+        self._pool: Optional[Any] = None
 
         # Event-driven object plane: local seals notify this condition so
         # wait_objects() long-polls wake immediately instead of the old 5 ms
@@ -257,6 +265,14 @@ class RayletService(ChaosPartitionRpc):
         os.makedirs(self._spill_dir, exist_ok=True)
         self._log_dir = os.path.join(os.path.dirname(sock_path) or ".", "logs")
         os.makedirs(self._log_dir, exist_ok=True)
+        from .worker_pool import WorkerPoolManager
+
+        self._pool = WorkerPoolManager(self, prestart=self._prestart_workers)
+        # Batched actor_started reports (flushed with the GCS sync
+        # buffers): a launch storm costs the GCS O(batches), not
+        # O(actors) — the epoch-fenced idempotent create path makes
+        # replayed batches safe.
+        self._started_buf: List[str] = []
         self._local_objects: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
         self._spilled: Dict[str, str] = {}
         self._spill_lock = lock_order.tracked_lock("raylet.spill")
@@ -306,10 +322,7 @@ class RayletService(ChaosPartitionRpc):
         self._reporter.start()
         for t in self._threads:
             t.start()
-        if CONFIG.worker_zygote:
-            threading.Thread(
-                target=self._boot_zygote, daemon=True, name="zygote-boot"
-            ).start()
+        self._pool.start()
 
     # ----------------------------------------------- control-plane batching
     def _notify_sealed(self, oid_hexes: List[str], primary: bool = True) -> None:
@@ -364,6 +377,9 @@ class RayletService(ChaosPartitionRpc):
             with self._buf_lock:
                 locs, self._loc_buf = self._loc_buf, []
                 evts, self._evt_buf = self._evt_buf, []
+                started, self._started_buf = self._started_buf, []
+            if started:
+                self._flush_actor_started(started, ep)
             if not locs and not evts:
                 continue
             try:
@@ -392,6 +408,50 @@ class RayletService(ChaosPartitionRpc):
                 # Stop-aware backoff: a plain sleep would hold shutdown
                 # hostage for the full backoff (blocking-in-loop lint).
                 self._stop.wait(0.5)
+
+    def _flush_actor_started(self, started: List[str], ep: int) -> None:
+        """One batched actor_started RPC for every constructor that
+        completed since the last flush (launch storms coalesce; the old
+        per-actor `actor_started` call serialized the GCS on O(actors)).
+        Per-actor False verdicts mean the record moved while our create
+        was in flight: that instance is a duplicate and dies locally —
+        identical semantics to the old synchronous path."""
+        try:
+            verdicts = self.gcs.call(
+                "actor_started_batch", self.node_id, started, ep
+            )
+        except exc.StaleNodeEpochError:
+            # This incarnation was fenced mid-launch: the GCS already
+            # moved these actors; our instances die with the fence.
+            self._fence("actor_started", ep)
+        except Exception:
+            with self._buf_lock:  # GCS briefly unreachable: retry later
+                self._started_buf = started + self._started_buf
+        else:
+            for aid, ok in (verdicts or {}).items():
+                if ok is False:
+                    self._kill_duplicate_instance(aid)
+
+    def _kill_duplicate_instance(self, aid: str) -> None:
+        """The GCS record for `aid` points elsewhere (an ambiguously
+        delivered create was retried onto another node while this
+        instance launched): kill the local duplicate WITHOUT an
+        actor_died report — the record is not ours to touch; the monitor
+        sees state DEAD and stays silent."""
+        _log.warning(
+            "actor %s started here but the GCS record points elsewhere: "
+            "killing the duplicate instance", aid[:8],
+        )
+        with self._actor_lock:
+            a = self._actors.get(aid)
+            wid = a.get("worker_id") if a else None
+            if a:
+                a["state"] = "DEAD"
+        if wid:
+            with self._workers_lock:
+                w = self._workers.get(wid)
+            if w:
+                w.proc.kill()
 
     # ------------------------------------------------------------ helpers
     def _remote(self, sock: str) -> RpcClient:
@@ -832,6 +892,8 @@ class RayletService(ChaosPartitionRpc):
     ) -> bool:
         """Hosts an actor (the GCS already picked this node). `bundle_index`
         carries the GCS-resolved bundle when the caller's spec said -1."""
+        if self._pool is not None:
+            self._pool.note_demand()  # launch-rate signal sizes the pool
         entry = pickle.loads(spec_blob)
         entry["type"] = "actor_creation"
         if bundle_index is not None and bundle_index >= 0:
@@ -855,6 +917,16 @@ class RayletService(ChaosPartitionRpc):
         self._task_event(entry["task_id"], "QUEUED", name=entry.get("desc", ""))
         self._enqueue(entry)
         return True
+
+    def create_actor_batch(self, items: List[Tuple[bytes, Optional[int]]]) -> int:
+        """Batched actor hosting: the GCS forwards a registration storm's
+        creations for this node in ONE RPC (each item is (spec_blob,
+        resolved_bundle_index)). Individually idempotent — create_actor
+        dedupes on the live actor table — so a replayed batch (RPC
+        reconnect resend) is safe."""
+        for blob, bundle_index in items:
+            self.create_actor(blob, True, bundle_index)
+        return len(items)
 
     def submit_actor_task(self, spec_blob: bytes) -> List[bytes]:
         entry = pickle.loads(spec_blob)
@@ -1578,6 +1650,7 @@ class RayletService(ChaosPartitionRpc):
             "available": avail,
             "waiting": [e.get("task_id") for e in self._waiting],
             "pending_qsize": self._pending.qsize(),
+            "pool": self._pool.stats() if self._pool is not None else {},
         }
 
     def flight_dump(self) -> dict:
@@ -1808,6 +1881,7 @@ class RayletService(ChaosPartitionRpc):
             w = self._workers.get(worker_id)
         if w is None:
             return {"type": "stop"}
+        w.ready = True  # boot complete: this worker counts as warm
         if w.busy_with is not None and w.mailbox.empty():
             # A serial worker only polls after completing its current task,
             # and its completion report is processed before this poll — so
@@ -1917,33 +1991,15 @@ class RayletService(ChaosPartitionRpc):
                         a = self._actors.get(aid)
                         if a:
                             a["state"] = "ALIVE"
-                    # _FENCED (fenced mid-launch: the GCS already moved
-                    # this actor; our instance dies with the fence) is
-                    # not False, so it skips the duplicate-kill below.
-                    accepted = self._gcs_call_fenced(
-                        "actor_started", "actor_started", aid, self.node_id
-                    )
-                    if accepted is False:
-                        # The record moved (or died) while our create
-                        # was in flight: this instance is a duplicate.
-                        # Kill it locally WITHOUT an actor_died report
-                        # — the record is not ours to touch; the
-                        # monitor sees state DEAD and stays silent.
-                        _log.warning(
-                            "actor %s started here but the GCS record "
-                            "points elsewhere: killing the duplicate "
-                            "instance", aid[:8],
-                        )
-                        with self._actor_lock:
-                            a = self._actors.get(aid)
-                            wid = a.get("worker_id") if a else None
-                            if a:
-                                a["state"] = "DEAD"
-                        if wid:
-                            with self._workers_lock:
-                                w = self._workers.get(wid)
-                            if w:
-                                w.proc.kill()
+                    # Coalesced registration: the actor_started report
+                    # rides the batched GCS flush (wake-driven, so the
+                    # added latency is sub-millisecond) — a launch storm
+                    # costs the GCS one RPC per batch instead of one per
+                    # actor. Duplicate-instance verdicts and fencing are
+                    # handled at flush time (_flush_actor_started).
+                    with self._buf_lock:
+                        self._started_buf.append(aid)
+                    self._buf_wake.set()
                 else:
                     with self._actor_lock:
                         a = self._actors.get(aid)
@@ -2115,8 +2171,13 @@ class RayletService(ChaosPartitionRpc):
                     )
                     if sp is not None:
                         sp["attrs"]["mode"] = "spawned"
-                elif sp is not None:
-                    sp["attrs"]["mode"] = "pooled"
+                else:
+                    # Warm-path hit: the launch adopted a live pooled
+                    # worker — worker_spawn collapses to this pop.
+                    if self._pool is not None:
+                        self._pool.note_hit("idle")
+                    if sp is not None:
+                        sp["attrs"]["mode"] = "pooled"
             self._obs_dispatch(entry)
             with self._actor_lock:
                 a = self._actors.get(entry["actor_id"])
@@ -2178,8 +2239,21 @@ class RayletService(ChaosPartitionRpc):
     def _pop_idle_locked(self, env_key: str) -> Optional["_Worker"]:
         """Pops a LIVE idle worker for this env (callers hold
         _workers_lock); shared by task checkout and actor-creation
-        conversion so liveness checks stay in one place."""
+        conversion so liveness checks stay in one place. READY workers
+        (boot complete, first poll seen) are preferred: a refill-spawned
+        worker enters the pool at fork time, and handing a launch a
+        still-booting worker serializes the launch behind that boot —
+        seconds on a loaded box — while booted pool-mates sit idle."""
         idle = self._idle.setdefault(env_key, [])
+        # Front-to-back: refills APPEND, so ready (oldest) workers sit at
+        # the head and the first hit is O(1) amortized — a back-to-front
+        # scan would walk the freshly-forked un-ready tail doing a /proc
+        # liveness read per entry under _workers_lock on every dispatch.
+        for i in range(len(idle)):
+            w = self._workers.get(idle[i])
+            if w is not None and w.ready and w.proc.poll() is None and w.actor_id is None:
+                del idle[i]
+                return w
         while idle:
             wid = idle.pop()
             w = self._workers.get(wid)
@@ -2191,6 +2265,8 @@ class RayletService(ChaosPartitionRpc):
         with self._workers_lock:
             w = self._pop_idle_locked(env_key)
             if w is not None:
+                if self._pool is not None:
+                    self._pool.note_hit("idle")
                 return w
             n_task_workers = sum(1 for w in self._workers.values() if w.actor_id is None)
             if n_task_workers < self._max_task_workers:
@@ -2207,54 +2283,104 @@ class RayletService(ChaosPartitionRpc):
                     return self._spawn_worker_locked(env_key=env_key)
         return None
 
-    def _boot_zygote(self) -> None:
-        """Starts the zygote daemon, waits for its socket, then prestarts
-        the configured idle worker pool through it (background; spawns
-        fall back to Popen until — or if never — ready)."""
-        from .zygote import ZygoteClient
+    def _default_spawn_spec(self) -> Tuple[str, List[str], Dict[str, str], str]:
+        """(worker_id, argv, env, log_base) — the SINGLE assembly of a
+        worker's base spawn identity, shared by _spawn_worker_locked and
+        the zygote batch-prestart path (two copies would silently drift:
+        an env var added to one class of 'default' worker and not the
+        other)."""
+        worker_id = uuid.uuid4().hex[:12]
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER"] = "1"
+        # Workers write their structured JSONL log next to their captured
+        # stdout/stderr, under this node's session log dir.
+        env["RAY_TPU_LOG_DIR"] = self._log_dir
+        log_base = os.path.join(self._log_dir, f"worker_{worker_id}")
+        argv = [
+            self.sock_path,
+            self.store_path,
+            self.gcs_sock,
+            worker_id,
+            self.node_id,
+        ]
+        return worker_id, argv, env, log_base
 
-        sock = os.path.join(
-            os.path.dirname(self.sock_path) or ".", f"zyg_{self.node_id[:8]}.sock"
-        )
-        try:
-            log = open(os.path.join(self._log_dir, "zygote.log"), "ab", buffering=0)
-            self._zygote_proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu.core.zygote", sock],
-                stdout=log,
-                stderr=log,
-            )
-            log.close()
-            deadline = time.monotonic() + 60.0
-            while time.monotonic() < deadline and not self._stop.is_set():
-                if os.path.exists(sock):
-                    self._zygote = ZygoteClient(sock)
-                    break
-                if self._zygote_proc.poll() is not None:
-                    break  # died at boot; Popen path serves everyone
-                time.sleep(0.05)
-        except Exception as e:  # noqa: BLE001
-            _log.warning("zygote boot failed: %r", e)
-            self._zygote = None
-        # Prestart (reference: worker_pool.h PrestartWorkers): a warm idle
-        # pool so the first task/actor burst never pays worker cold-start.
-        # Forked through the zygote these cost ~10 ms each.
-        try:
-            with self._workers_lock:
-                have = len(self._workers)
-            for _ in range(max(0, self._prestart_workers - have)):
-                if self._stop.is_set():
-                    return
+    def _prestart_idle(self, n: int) -> int:
+        """Spawns `n` default-env idle workers into the pool (boot
+        prestart + the pool manager's refill). Batched through the
+        zygote when it is up — ONE socket round trip forks all of them,
+        each preferentially taking a parked pre-forked child — with a
+        per-worker Popen fallback. Prestarted workers MUST enter the
+        idle pool: they are otherwise invisible to _checkout_worker
+        while still counting against _max_task_workers — a prestart that
+        fills the cap before the first submit would leave the node
+        unable to dispatch anything, ever."""
+        if n <= 0:
+            return 0
+        from .zygote import PidHandle, ZygoteClient
+
+        pool = self._pool
+        if pool is not None and CONFIG.worker_zygote:
+            specs, wids = [], []
+            for _ in range(n):
+                wid, argv, env, log_base = self._default_spawn_spec()
+                specs.append(
+                    ZygoteClient.spawn_spec(
+                        argv, env, log_base + ".out", log_base + ".err"
+                    )
+                )
+                wids.append(wid)
+            try:
+                t0 = time.perf_counter()
+                results = pool.zygote_spawn_batch(specs)
+                per_ms = (time.perf_counter() - t0) * 1e3 / max(1, len(results))
                 with self._workers_lock:
-                    w = self._spawn_worker_locked(env_key="")
-                    # Prestarted workers MUST enter the idle pool: they are
-                    # otherwise invisible to _checkout_worker while still
-                    # counting against _max_task_workers — a prestart that
-                    # fills the cap before the first submit would leave the
-                    # node unable to dispatch anything, ever.
+                    for wid, (pid, _warm) in zip(wids, results):
+                        w = _Worker(wid, PidHandle(pid), env_key="")
+                        self._workers[wid] = w
+                        self._idle.setdefault("", []).append(wid)
+                for _pid, warm in results:
+                    mode = "prefork" if warm else "zygote"
+                    imet.WORKER_SPAWN_TOTAL.inc(mode=mode)
+                    imet.ZYGOTE_FORK_LATENCY.observe(per_ms, mode=mode)
+                self._sched_wake.set()
+                return len(results)
+            except Exception as e:
+                _log.debug("batched prestart fell back to popen: %r", e)
+        spawned = 0
+        for _ in range(n):
+            if self._stop.is_set():
+                break
+            try:
+                with self._workers_lock:
+                    w = self._spawn_worker_locked(env_key="", _pool_refill=True)
                     self._idle.setdefault("", []).append(w.worker_id)
-            self._sched_wake.set()  # fresh pool may unblock queued work
-        except Exception as e:  # noqa: BLE001
-            _log.warning("worker prestart failed: %r", e)
+                spawned += 1
+            except Exception as e:  # noqa: BLE001
+                _log.warning("worker prestart failed: %r", e)
+                break
+        if spawned:
+            self._sched_wake.set()
+        return spawned
+
+    def _retire_idle(self, k: int) -> int:
+        """Stops up to `k` idle pooled workers (pool-manager shrink once
+        demand decays). Popped out of the idle lists under the lock
+        first, so a concurrent checkout can never adopt a worker that
+        was just told to stop."""
+        retired = 0
+        with self._workers_lock:
+            for lst in self._idle.values():
+                while lst and retired < k:
+                    wid = lst.pop(0)  # oldest first
+                    w = self._workers.get(wid)
+                    if w is None or w.proc.poll() is not None:
+                        continue
+                    w.mailbox.put({"type": "stop"})
+                    retired += 1
+                if retired >= k:
+                    break
+        return retired
 
     def _spawn_worker(
         self, actor_id: Optional[str] = None, env_key: str = "", runtime_env=None
@@ -2263,14 +2389,13 @@ class RayletService(ChaosPartitionRpc):
             return self._spawn_worker_locked(actor_id, env_key, runtime_env)
 
     def _spawn_worker_locked(
-        self, actor_id: Optional[str] = None, env_key: str = "", runtime_env=None
+        self,
+        actor_id: Optional[str] = None,
+        env_key: str = "",
+        runtime_env=None,
+        _pool_refill: bool = False,
     ) -> _Worker:
-        worker_id = uuid.uuid4().hex[:12]
-        env = dict(os.environ)
-        env["RAY_TPU_WORKER"] = "1"
-        # Workers write their structured JSONL log next to their captured
-        # stdout/stderr, under this node's session log dir.
-        env["RAY_TPU_LOG_DIR"] = self._log_dir
+        worker_id, worker_args, env, log_base = self._default_spawn_spec()
         desc = json.loads(env_key) if env_key else {}
         if runtime_env:
             desc.setdefault("runtime_env", runtime_env)
@@ -2303,47 +2428,44 @@ class RayletService(ChaosPartitionRpc):
                     worker_index=tpu.get("worker_index", 0),
                 )
             )
-        # Worker stdout/stderr land in per-process session log files
-        # (reference: worker-<id>-out/err under the session's logs dir) —
-        # a user print inside a task must be recoverable.
-        log_base = os.path.join(self._log_dir, f"worker_{worker_id}")
-        worker_args = [
-            self.sock_path,
-            self.store_path,
-            self.gcs_sock,
-            worker_id,
-            self.node_id,
-        ]
         prefix = (renv or {}).get("_command_prefix")
-        zygote = self._zygote
         if (
-            zygote is not None
+            self._pool is not None
             and py_exe == sys.executable
             and not prefix
             and not (renv or {}).get("env_vars")
         ):
-            # Fast path: fork from the pre-warmed zygote (~10 ms) — only
-            # for workers running THIS interpreter, no container wrap, and
+            # Fast path: fork from the pre-warmed zygote — only for
+            # workers running THIS interpreter, no container wrap, and
             # no user env_vars: the zygote pre-imported the worker stack,
             # so import-time vars (JAX_*, RAY_TPU_* config) set after the
             # fork would silently not take effect; those envs Popen.
+            # A parked pre-forked child serves the request in ~1-2 ms
+            # (pool hit, tier=prefork); an empty parked pool pays the
+            # ~10 ms fork (miss, mode=zygote).
             try:
                 t0 = time.perf_counter()
-                pid = zygote.spawn(
+                pid, warm = self._pool.zygote_spawn(
                     worker_args, env, log_base + ".out", log_base + ".err"
                 )
+                mode = "prefork" if warm else "zygote"
                 imet.ZYGOTE_FORK_LATENCY.observe(
-                    (time.perf_counter() - t0) * 1e3, mode="zygote"
+                    (time.perf_counter() - t0) * 1e3, mode=mode
                 )
-                imet.WORKER_SPAWN_TOTAL.inc(mode="zygote")
+                imet.WORKER_SPAWN_TOTAL.inc(mode=mode)
+                if not _pool_refill:
+                    if warm:
+                        self._pool.note_hit("prefork")
+                    else:
+                        self._pool.note_miss("zygote")
                 from .zygote import PidHandle
 
                 w = _Worker(worker_id, PidHandle(pid), env_key=env_key)
                 w.actor_id = actor_id
                 self._workers[worker_id] = w
                 return w
-            except Exception:
-                self._zygote = None  # daemon gone: Popen from now on
+            except Exception:  # lint: swallow-ok(pool manager was notified and respawns; THIS spawn falls back to Popen below)
+                pass
         out_f = open(log_base + ".out", "ab", buffering=0)
         err_f = open(log_base + ".err", "ab", buffering=0)
         argv = [py_exe, "-m", "ray_tpu.core.worker_proc", *worker_args]
@@ -2376,6 +2498,8 @@ class RayletService(ChaosPartitionRpc):
                 (time.perf_counter() - t0) * 1e3, mode="popen"
             )
             imet.WORKER_SPAWN_TOTAL.inc(mode="popen")
+            if self._pool is not None and not _pool_refill:
+                self._pool.note_miss("popen")
         finally:
             out_f.close()
             err_f.close()
@@ -2606,6 +2730,10 @@ class RayletService(ChaosPartitionRpc):
                 "num_spilled": n_spilled,
                 "num_workers": n_workers,
             }
+            if self._pool is not None:
+                # Pool health rides the heartbeat: `ray-tpu status
+                # --verbose` renders it per node without an extra RPC.
+                stats["pool"] = self._pool.stats()
             if self._draining:
                 # Propagate raylet-initiated drains (chaos, local admin)
                 # into the GCS node record; GCS-initiated drains already
@@ -2622,6 +2750,10 @@ class RayletService(ChaosPartitionRpc):
                 )
                 if isinstance(reply, dict):
                     self._cluster_size = reply.get("nodes", self._cluster_size)
+                    if self._pool is not None:
+                        # Demand hint: pending actors the GCS placed on
+                        # this node + the autoscaler forecast share.
+                        self._pool.set_hint(int(reply.get("pool_hint", 0) or 0))
                     if not reply.get("ok", True):
                         # The GCS restarted without our registration (lost
                         # or stale snapshot): re-register (reference:
@@ -2741,6 +2873,14 @@ class RayletService(ChaosPartitionRpc):
             with self._buf_lock:
                 self._loc_buf.clear()
                 self._evt_buf.clear()
+                self._started_buf.clear()
+            # Pre-forked pool teardown: parked zygote children forked by
+            # the old incarnation are drained (reaped like the leased
+            # workers above) — no pre-forked worker may outlive the
+            # incarnation that forked it; the pool manager rebuilds the
+            # parked pool for the fresh incarnation.
+            if self._pool is not None:
+                self._pool.on_fence()
             # Plasma pins: the directory already dropped this node's
             # locations; forget the old life's primaries so post-rejoin
             # syncs cannot re-advertise them.
@@ -2805,8 +2945,10 @@ class RayletService(ChaosPartitionRpc):
             for w in self._workers.values():
                 if w.proc.poll() is None:
                     w.proc.terminate()
-        if self._zygote_proc is not None and self._zygote_proc.poll() is None:
-            self._zygote_proc.kill()
+        if self._pool is not None:
+            # Kills the zygote daemon; its parked pre-forks die with it
+            # via their PR_SET_PDEATHSIG tie.
+            self._pool.stop()
         return True
 
 

@@ -33,9 +33,21 @@ from typing import Any, Dict, List, Optional
 
 import cloudpickle
 
+# The FULL worker stack imports at module level (not lazily inside
+# main()): the zygote pre-imports this module once, so every pre-forked
+# child inherits the ~2 s import graph via COW pages and its remaining
+# boot is just socket connects + store attach — the "fork after the
+# expensive setup, not before" half of warm-path actor launch. All of
+# these are import-safe (no jax backend init; tools/check_import_safety).
+from .. import exceptions as exc
 from ..chaos.controller import kill_now as _chaos_kill
 from ..chaos.controller import maybe_inject as _chaos_inject
+from . import runtime_base, serialization
+from .cluster_runtime import ClusterRuntime
 from .ids import ActorID, ObjectID
+from .object_transport import StoredError
+from .rpc import RpcClient, _recv_msg, _send_msg
+from .shm_store import SharedMemoryStore
 from .task_spec import GLOBAL_FUNCTION_TABLE
 
 
@@ -155,13 +167,6 @@ def main(argv: List[str]) -> None:
     import queue
     import socket as socketlib
     import time
-
-    from .. import exceptions as exc
-    from . import runtime_base, serialization
-    from .cluster_runtime import ClusterRuntime
-    from .object_transport import StoredError
-    from .rpc import RpcClient, _recv_msg, _send_msg
-    from .shm_store import SharedMemoryStore
 
     # Pin jax's platform set when the launcher asks (tests export
     # RAY_TPU_JAX_PLATFORMS=cpu so workers never INITIALIZE the tunneled

@@ -53,6 +53,7 @@ KIND_PREFIXES = {
     "lock",      # utils/lock_order.py order-cycle / long-hold reports
     "net",       # chaos network partitions (install/heal/blocked sends)
     "node",      # node lifecycle (drain notices, death, fencing, rejoin)
+    "pool",      # worker-pool refills + zygote lifecycle (loss/respawn)
     "sched",     # raylet scheduler queue/dispatch
     "train",     # trainer drain/restore/elastic transitions
     "watchdog",  # SLO watchdog alerts

@@ -184,10 +184,28 @@ def cmd_status(args) -> None:
             if labels.get("tpu_topology"):
                 slice_info += f" topology={labels['tpu_topology']}"
         epoch_info = f" epoch={n['Epoch']}" if n.get("Epoch") is not None else ""
+        pool_info = ""
+        if getattr(args, "verbose", False):
+            # Warm-pool health (rides the heartbeat stats): inventory vs
+            # the forecast-sized target, plus the hit/miss counters that
+            # say whether launches are going warm.
+            p = (n.get("Stats") or {}).get("pool") or {}
+            if p:
+                hits = p.get("hits") or {}
+                misses = p.get("misses") or {}
+                pool_info = (
+                    f" pool={p.get('ready', 0)}/{p.get('target', 0)}"
+                    f"(+{p.get('preforked', 0)}pf)"
+                    f" hits={sum(hits.values())} misses={sum(misses.values())}"
+                )
+                if not p.get("zygote_alive", True):
+                    pool_info += " zygote=DOWN"
+                elif p.get("zygote_respawns"):
+                    pool_info += f" zygote_respawns={p['zygote_respawns']}"
         print(
             f"  [{mark}] {n['NodeID'][:12]}{epoch_info} resources={n['Resources']} "
             f"available={n['Available']} workers={n['Stats'].get('num_workers', 0)}"
-            f"{slice_info}"
+            f"{pool_info}{slice_info}"
         )
     print(f"tasks: {stats['tasks']}")
     print(f"actors: {stats['actors']}")
@@ -1043,6 +1061,11 @@ def main(argv=None) -> None:
     p.set_defaults(fn=cmd_stop)
 
     p = sub.add_parser("status", help="cluster nodes/tasks/store summary")
+    p.add_argument(
+        "--verbose",
+        action="store_true",
+        help="per-node worker-pool column (ready/target, preforks, hit/miss)",
+    )
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_status)
 

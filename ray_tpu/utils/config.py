@@ -58,6 +58,22 @@ class RayTpuConfig:
     # Fork workers from a pre-warmed zygote daemon (~10 ms vs ~2 s cold
     # python+jax startup per worker). RAY_TPU_WORKER_ZYGOTE=0 disables.
     worker_zygote: bool = _declare("worker_zygote", True)
+    # Warm-path launch: keep the idle worker pool + the zygote's parked
+    # pre-fork pool topped up to a forecast-sized target (EWMA of recent
+    # launch rate + the GCS's pending-actor/autoscaler hint), refilled
+    # asynchronously after every pop. RAY_TPU_WORKER_POOL=0 reverts to
+    # the PR-1 behavior (one-shot prestart, fork-on-demand after).
+    worker_pool: bool = _declare("worker_pool", True)
+    # Hard cap on the live idle pool the refill loop maintains per node.
+    worker_pool_max: int = _declare("worker_pool_max", 64)
+    # Demand horizon: target += ceil(recent launches/s * horizon).
+    worker_pool_horizon_s: float = _declare("worker_pool_horizon_s", 0.5)
+    # Parked pre-forked children the zygote keeps ready (floor / cap);
+    # between them the parked target follows the same demand signal.
+    worker_pool_prefork: int = _declare("worker_pool_prefork", 2)
+    worker_pool_prefork_max: int = _declare("worker_pool_prefork_max", 16)
+    # Pool maintenance cadence (refill / zygote-respawn checks).
+    worker_pool_interval_s: float = _declare("worker_pool_interval_s", 0.25)
 
     # --- object store ------------------------------------------------------
     # Default per-node shared-memory pool size.

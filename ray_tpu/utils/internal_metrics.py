@@ -431,11 +431,47 @@ WORKER_POOL_LEASED = Gauge(
     "Workers leased to owners for direct pushes",
     component="worker_pool",
 )
+WORKER_POOL_HITS = Counter(
+    "raytpu_worker_pool_hits_total",
+    "Worker demand served warm, by tier: idle (live pooled worker "
+    "adopted) or prefork (zygote parked child assigned)",
+    component="worker_pool",
+    tag_keys=("tier",),
+)
+WORKER_POOL_MISSES = Counter(
+    "raytpu_worker_pool_misses_total",
+    "Worker demand that paid a cold spawn, by mechanism (zygote fork "
+    "or popen exec)",
+    component="worker_pool",
+    tag_keys=("mode",),
+)
+WORKER_POOL_SIZE = Gauge(
+    "raytpu_worker_pool_size",
+    "Warm-pool inventory by tier: idle live workers / zygote parked "
+    "pre-forks",
+    component="worker_pool",
+    tag_keys=("tier",),
+)
+WORKER_POOL_TARGET = Gauge(
+    "raytpu_worker_pool_target",
+    "Forecast-sized idle-pool target the refill loop maintains",
+    component="worker_pool",
+)
+WORKER_POOL_REFILL_LAG = Gauge(
+    "raytpu_worker_pool_refill_lag",
+    "Workers the idle pool is short of its target (refill in flight)",
+    component="worker_pool",
+)
 WORKER_SPAWN_TOTAL = Counter(
     "raytpu_worker_spawn_total",
     "Worker processes spawned, by mechanism",
     component="zygote",
     tag_keys=("mode",),
+)
+ZYGOTE_RESPAWNS = Counter(
+    "raytpu_zygote_respawns_total",
+    "Zygote daemons respawned after death (the prestart pool is rebuilt)",
+    component="zygote",
 )
 ZYGOTE_FORK_LATENCY = Histogram(
     "raytpu_zygote_fork_latency_ms",

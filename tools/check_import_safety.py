@@ -83,6 +83,13 @@ REQUIRED = {
     # controller, and driver at module load; a backend init here would
     # wedge every control plane at boot.
     "ray_tpu.utils.lock_order",
+    # The warm-pool layer: the zygote pre-imports the ENTIRE worker
+    # stack before forking (an import-time backend init there would
+    # wedge every pre-forked worker), and the pool manager imports into
+    # every raylet.
+    "ray_tpu.core.worker_pool",
+    "ray_tpu.core.zygote",
+    "ray_tpu.core.worker_proc",
 }
 
 
