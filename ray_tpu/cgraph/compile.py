@@ -323,6 +323,18 @@ class CompiledGraph:
                 if dump_path
                 else ""
             )
+            from ..observability.postmortem import publish_trigger
+
+            publish_trigger(
+                "cgraph.timeout",
+                {
+                    "dag": self._dag_id[:8],
+                    "seq": seq,
+                    "blocked_channel": blocked,
+                    "dump": dump_path,
+                },
+                source="cgraph",
+            )
             raise TimeoutError(
                 f"compiled graph {self._dag_id[:8]}: execute() result for "
                 f"seq {seq} not ready after {timeout}s (blocked on channel "

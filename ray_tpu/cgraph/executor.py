@@ -187,6 +187,13 @@ class GraphExecutor:
                 _frec.dump(
                     reason=f"cgraph exec loop crash (dag {dag8}, seq {seq})"
                 )
+                from ..observability.postmortem import publish_trigger
+
+                publish_trigger(
+                    "cgraph.crash",
+                    {"dag": dag8, "seq": seq},
+                    source="cgraph",
+                )
                 break
             seq += 1
         # Cascade the shutdown: whatever ended this loop (teardown, a dead

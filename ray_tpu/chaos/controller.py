@@ -236,6 +236,13 @@ class ChaosController:
         # Cause before symptom: the flight record lands before the fault
         # is applied, so a post-mortem trace orders them correctly.
         _flight_record("chaos.inject", (point, rule.action, detail))
+        from ..observability.postmortem import publish_trigger
+
+        publish_trigger(
+            "chaos.inject",
+            {"point": point, "action": rule.action, "detail": detail},
+            source="chaos",
+        )
         try:
             from ..utils import internal_metrics as imet
 

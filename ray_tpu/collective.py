@@ -177,6 +177,17 @@ class _Group:
             missing = self._missing_ranks()
         if record:
             _flight_record("coll.timeout", (self.name, self.rank, tuple(missing)))
+            from .observability.postmortem import publish_trigger
+
+            publish_trigger(
+                "coll.timeout",
+                {
+                    "group": self.name,
+                    "rank": self.rank,
+                    "missing": list(missing),
+                },
+                source="collective",
+            )
         raise CollectiveTimeoutError(
             self.name, self.rank, self.world_size, missing=missing, detail=detail
         )
@@ -351,6 +362,13 @@ class _Group:
         hang (the old behavior: blocking recv with no timeout) leaves a
         gang wedged with nothing to post-mortem."""
         _flight_record("coll.timeout", (self.name, self.rank, (peer,)))
+        from .observability.postmortem import publish_trigger
+
+        publish_trigger(
+            "coll.timeout",
+            {"group": self.name, "rank": self.rank, "missing": [peer]},
+            source="collective",
+        )
         raise CollectiveTimeoutError(
             self.name,
             self.rank,

@@ -223,6 +223,12 @@ class ClusterRuntime(Runtime):
         from ..observability import flight_recorder as _frec
 
         _frec.install_crash_hooks("driver" if driver else "worker")
+        # Arm the anomaly trigger bus: cgraph timeouts, collective stalls,
+        # and job failures detected in this process forward to the GCS's
+        # report_trigger RPC (debounced client-side; see postmortem.py).
+        from ..observability import postmortem as _postmortem
+
+        _postmortem.arm_client(gcs)
         self._actor_location: Dict[str, str] = {}  # actor_id -> raylet sock
         self._raylet_clients: Dict[str, RpcClient] = {}
         # Actor creations coalesce through a leader-follower batcher
@@ -1398,6 +1404,13 @@ class ClusterRuntime(Runtime):
         if self._shutdown_done:
             return
         self._shutdown_done = True
+        # Disarm the trigger bus first: its forwarder wraps this
+        # runtime's GCS client, and anything published during or after
+        # teardown (chaos injection in a later test, a watchdog tick)
+        # would otherwise dial a dead control plane.
+        from ..observability import postmortem as _postmortem
+
+        _postmortem.disarm()
         self._free_wake.set()
         self._submit_wake.set()
         try:

@@ -17,12 +17,14 @@ the resource view used for spillback decisions.
 from __future__ import annotations
 
 import collections
+import os
 import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ..chaos.net import ChaosPartitionRpc
+from ..observability import postmortem as _postmortem
 from ..exceptions import (
     ActorNameTakenError,
     PlacementGroupError,
@@ -51,9 +53,16 @@ TASK_TABLE_CAP = 50_000
 
 
 class GcsService(ChaosPartitionRpc):
-    def __init__(self, snapshot_path: Optional[str] = None):
+    def __init__(
+        self,
+        snapshot_path: Optional[str] = None,
+        session_dir: Optional[str] = None,
+    ):
         self._lock = lock_order.tracked_rlock("gcs.state")
         self._snapshot_path = snapshot_path
+        self._session_dir = session_dir or (
+            os.path.dirname(snapshot_path) if snapshot_path else None
+        )
         self._nodes: Dict[str, dict] = {}
         # Monotonic per-node registration epochs (persisted): every
         # register_node stamps the next epoch for that node id, and every
@@ -148,6 +157,17 @@ class GcsService(ChaosPartitionRpc):
                     metrics_fn=self.internal_metrics,
                 )
                 self._watchdog.start()
+        # Anomaly trigger bus (observability/postmortem.py): incoming
+        # triggers — remote via the report_trigger RPC, in-process via
+        # the armed publisher — coalesce into incidents; each fresh
+        # incident runs ONE harvest fan-out off-thread. Bounded ring of
+        # incident records; bundles live under <session>/incidents/.
+        self._incident_lock = lock_order.tracked_lock("gcs.incidents")
+        self._incidents: "collections.OrderedDict[str, dict]" = collections.OrderedDict()
+        self._open_incident: Optional[str] = None
+        # In-process anomaly sources (the watchdog thread, chaos faults
+        # injected inside THIS process) publish straight to _trigger.
+        _postmortem.arm(self._trigger)
 
     # ------------------------------------------------------- persistence
     # Durable control-plane state (reference: gcs/store_client/
@@ -434,6 +454,11 @@ class GcsService(ChaosPartitionRpc):
                     "ts": time.time(),
                 },
             )
+            self._trigger(
+                "node.fenced",
+                {"node_id": node_id[:12], "epoch": epoch, "current": cur},
+                source="gcs",
+            )
         raise StaleNodeEpochError(
             node_id,
             claimed_epoch=epoch,
@@ -477,6 +502,16 @@ class GcsService(ChaosPartitionRpc):
                     n["stats"] = dict(stats)
                     if stats.get("draining") and not n.get("draining"):
                         raylet_drained = True
+                    # Clock-offset sampling on the heartbeat path: the
+                    # raylet stamps its wall-clock send time; offset =
+                    # gcs_now - send_time (network latency folds in, a
+                    # one-way UDS/TCP hop — microseconds against the
+                    # inter-host skews this corrects). The incident
+                    # merger shifts that node's flight/span timestamps
+                    # by this to restore cross-node causal order.
+                    wall = stats.get("wall_ts")
+                    if isinstance(wall, (int, float)):
+                        n["clock_offset_us"] = int((time.time() - wall) * 1e6)
                 n["last_hb"] = time.monotonic()
         if verdict is not None:
             # A heartbeat from a dead-marked node used to flip it back
@@ -935,6 +970,7 @@ class GcsService(ChaosPartitionRpc):
             "node_events",
             {"event": "node_dead", "node_id": node_id, "ts": time.time()},
         )
+        self._trigger("node.dead", {"node_id": node_id[:12]}, source="gcs")
         gangs: List[str] = []
         with self._lock:
             for pg_id, pg in self._pgs.items():
@@ -2087,6 +2123,251 @@ class GcsService(ChaosPartitionRpc):
 
         return _frec.dump(reason="gcs flight_dump rpc")
 
+    # ------------------------------------------------------- trigger bus
+    @staticmethod
+    def _postmortem_enabled() -> bool:
+        return os.environ.get("RAY_TPU_POSTMORTEM") != "0"
+
+    @staticmethod
+    def _coalesce_window_s() -> float:
+        try:
+            return float(os.environ.get("RAY_TPU_INCIDENT_WINDOW_S", "10.0"))
+        except ValueError:
+            return 10.0
+
+    def report_trigger(
+        self, kind: str, detail: Any = None, source: Optional[str] = None
+    ) -> dict:
+        """Remote half of the trigger bus (raylets/drivers/workers
+        forward their anomaly triggers here via postmortem.arm_client)."""
+        return self._trigger(kind, detail, source)
+
+    def _trigger(
+        self, kind: str, detail: Any = None, source: Optional[str] = None
+    ) -> dict:
+        """One anomaly trigger: coalesces into the open incident when its
+        last trigger is within the (sliding) coalesce window — a chaos
+        soak's 50 faults become one incident's trigger chain, not 50
+        full-ring harvests — else opens a fresh incident and starts its
+        harvest off-thread (the harvest fans RPCs through every raylet;
+        it must never run on an RPC handler or under a state lock)."""
+        if not self._postmortem_enabled():
+            return {"ok": False, "disabled": True}
+        ev = {
+            "ts": time.time(),
+            "ts_us": time.time_ns() // 1000,
+            "kind": kind,
+            "detail": _postmortem.safe_detail(detail),
+            "source": source,
+        }
+        imet.POSTMORTEM_TRIGGERS.inc(kind=kind)
+        fresh = False
+        with self._incident_lock:
+            inc = (
+                self._incidents.get(self._open_incident)
+                if self._open_incident
+                else None
+            )
+            now_mono = time.monotonic()
+            if (
+                inc is not None
+                and now_mono - inc["last_mono"] <= self._coalesce_window_s()
+            ):
+                inc["last_mono"] = now_mono
+                inc["triggers"].append(ev)
+                inc["coalesced"] += 1
+                iid = inc["id"]
+            else:
+                iid = f"inc-{ev['ts_us']}-{kind.replace('.', '-')}"
+                self._incidents[iid] = {
+                    "id": iid,
+                    "opened_ts": ev["ts"],
+                    "opened_mono": now_mono,
+                    "last_mono": now_mono,
+                    "state": "open",
+                    "triggers": [ev],
+                    "coalesced": 0,
+                    "bundle": None,
+                }
+                self._open_incident = iid
+                fresh = True
+                while len(self._incidents) > 64:
+                    self._incidents.popitem(last=False)
+        if fresh:
+            _frec_record("incident.open", (iid, kind))
+            imet.POSTMORTEM_INCIDENTS.inc()
+            _log.warning(
+                "incident %s opened by trigger %s (source=%s); harvesting",
+                iid, kind, source,
+            )
+            self.pubsub_publish(
+                "node_events",
+                {"event": "incident", "incident_id": iid, "trigger": kind,
+                 "ts": ev["ts"]},
+            )
+            threading.Thread(
+                target=self._harvest, args=(iid,), daemon=True,
+                name=f"harvest-{iid[:20]}",
+            ).start()
+        return {"ok": True, "incident": iid, "coalesced": not fresh}
+
+    def _harvest(self, incident_id: str) -> None:
+        """The incident harvest: after a short settle delay (lets the
+        trigger chain accumulate and secondary failures land), fans
+        `flight_dump` through every alive raylet (each SIGUSR2s its
+        workers so their rings dump too), snapshots the GCS's own ring,
+        tails structured logs, freezes the metrics-history window, and
+        stages the bundle + clock-offset manifest, then builds the
+        merged skew-corrected trace."""
+        from ..observability import flight_recorder as _frec
+
+        try:
+            delay = float(os.environ.get("RAY_TPU_HARVEST_DELAY_S", "0.75"))
+        except ValueError:
+            delay = 0.75
+        time.sleep(max(0.0, delay))
+        with self._incident_lock:
+            inc = self._incidents.get(incident_id)
+            if inc is None:
+                return
+            inc["state"] = "harvesting"
+        try:
+            with self._lock:
+                nodes = [
+                    (nid, n["sock"], int(n.get("clock_offset_us") or 0))
+                    for nid, n in self._nodes.items()
+                    if n["alive"]
+                ]
+            pids: Dict[str, dict] = {
+                str(os.getpid()): {"node": "gcs", "offset_us": 0}
+            }
+            node_info: Dict[str, dict] = {}
+            logs: List[dict] = []
+            for nid, sock, offset_us in nodes:
+                node_info[nid[:12]] = {"offset_us": offset_us}
+                try:
+                    res = self._raylet_call(sock, "flight_dump")
+                except Exception as e:  # lint: swallow-ok(dead/partitioned raylet; harvest the reachable rings)
+                    node_info[nid[:12]]["error"] = repr(e)[:200]
+                    continue
+                node_info[nid[:12]]["dump"] = (res or {}).get("path")
+                for pid in (res or {}).get("pids") or ():
+                    pids[str(pid)] = {"node": nid[:12], "offset_us": offset_us}
+                try:
+                    logs.extend(
+                        self._raylet_call(sock, "tail_logs", {"tail": 300})
+                        or []
+                    )
+                except Exception:  # lint: swallow-ok(log tails are enrichment; the rings are the contract)
+                    pass
+            _frec.dump(reason=f"incident harvest {incident_id}")
+            # Give SIGUSR2'd workers a beat to land their rings before
+            # the bundle copies the flight dir.
+            time.sleep(0.5)
+            with self._incident_lock:
+                triggers = list(inc["triggers"])
+            window_s = max(
+                60.0, time.time() - (triggers[0]["ts"] - 30.0)
+            )
+            metrics = (
+                self._history.query(window_s=window_s)
+                if self._history is not None
+                else []
+            )
+            goodput: Dict[str, Any] = {}
+            for series in metrics:
+                if series.get("name") == "raytpu_train_goodput" and series.get("samples"):
+                    goodput["goodput"] = series["samples"][-1][1]
+                if series.get("name") == "raytpu_train_mfu" and series.get("samples"):
+                    goodput["mfu"] = series["samples"][-1][1]
+            logs.sort(key=lambda r: r.get("ts") or 0.0)
+            manifest = {
+                "incident_id": incident_id,
+                "opened_ts": triggers[0]["ts"],
+                "triggers": triggers,
+                "nodes": node_info,
+                "pids": pids,
+                "goodput": goodput,
+                "impact_window_s": window_s,
+            }
+            bundle_dir = os.path.join(
+                _postmortem.incidents_dir(self._session_dir), incident_id
+            )
+            _postmortem.stage_bundle(
+                bundle_dir, manifest, log_records=logs[-1000:], metrics=metrics
+            )
+            _postmortem.merge_trace(bundle_dir)
+            with self._incident_lock:
+                inc["state"] = "staged"
+                inc["bundle"] = bundle_dir
+            _frec_record("incident.staged", (incident_id, bundle_dir))
+            _log.warning(
+                "incident %s staged: %s (render with `ray-tpu postmortem %s`)",
+                incident_id, bundle_dir, incident_id,
+            )
+        except Exception:
+            _log.exception("incident %s harvest failed", incident_id)
+            with self._incident_lock:
+                inc["state"] = "failed"
+
+    def list_incidents(self) -> List[dict]:
+        """Incident records, oldest first (state API / CLI)."""
+        with self._incident_lock:
+            return [
+                {
+                    "incident_id": i["id"],
+                    "opened_ts": i["opened_ts"],
+                    "state": i["state"],
+                    "trigger": i["triggers"][0]["kind"] if i["triggers"] else None,
+                    "triggers": len(i["triggers"]),
+                    "bundle": i["bundle"],
+                }
+                for i in self._incidents.values()
+            ]
+
+    def get_incident(self, incident_id: str) -> Optional[dict]:
+        with self._incident_lock:
+            inc = self._incidents.get(incident_id)
+            if inc is None:
+                return None
+            out = dict(inc)
+            out["triggers"] = list(inc["triggers"])
+            return out
+
+    def debug_harvest(self, timeout_s: float = 20.0) -> dict:
+        """`ray-tpu debug dump`: raises a manual trigger and waits for
+        its incident's bundle to stage, so the CLI can print ONE bundle
+        path + a ready-to-run postmortem hint instead of a loose
+        per-process dump list. Coalesces like any other trigger — a dump
+        requested mid-incident returns that incident's bundle."""
+        res = _postmortem.publish_trigger(
+            "debug.manual", None, source="ray-tpu debug dump"
+        )
+        if not isinstance(res, dict) or not res.get("ok"):
+            # Client-side debounce (a second dump inside the window) or
+            # the bus is disabled: fall back to whatever is open.
+            with self._incident_lock:
+                iid = self._open_incident
+            if iid is None:
+                return {"ok": False, "reason": "trigger bus disabled or debounced"}
+        else:
+            iid = res["incident"]
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            inc = self.get_incident(iid)
+            if inc is None:
+                break
+            if inc["state"] in ("staged", "failed"):
+                return {
+                    "ok": inc["state"] == "staged",
+                    "incident": iid,
+                    "state": inc["state"],
+                    "bundle": inc["bundle"],
+                    "triggers": inc["triggers"],
+                }
+            time.sleep(0.1)
+        return {"ok": False, "incident": iid, "reason": "harvest timed out"}
+
     # chaos_partition / chaos_heal: inherited from ChaosPartitionRpc
     # (chaos/net.py) — one definition shared with the raylet.
 
@@ -2094,6 +2375,9 @@ class GcsService(ChaosPartitionRpc):
         self._stop.set()
         if self._watchdog is not None:
             self._watchdog.stop()
+        # Only disarm if this service is still the armed publisher — a
+        # test that booted a newer in-process GCS keeps its bus.
+        _postmortem.disarm(self._trigger)
         return True
 
 
@@ -2118,7 +2402,10 @@ def main(
         directory=os.path.join(os.path.dirname(sock_path) or ".", "logs"),
     )
     _logs.get_logger("gcs").info("gcs daemon started (pid %d)", os.getpid())
-    service = GcsService(snapshot_path=snapshot_path or sock_path + ".snapshot")
+    service = GcsService(
+        snapshot_path=snapshot_path or sock_path + ".snapshot",
+        session_dir=os.path.dirname(sock_path) or ".",
+    )
     # The GCS's own internal metrics merge straight into its table — no
     # self-RPC loop (reference: the head metrics agent scraping itself).
     imet.configure(

@@ -101,6 +101,12 @@ class RayletService(ChaosPartitionRpc):
         self.store = SharedMemoryStore.create(store_path, store_capacity)
         self.gcs = RpcClient(gcs_sock)
         self.gcs_sock = gcs_sock
+        # Arm the anomaly trigger bus: raylet-side anomalies (chaos
+        # injections, watchdog-adjacent events seen here) forward to the
+        # GCS's report_trigger RPC for debounce + incident harvest.
+        from ..observability import postmortem as _postmortem
+
+        _postmortem.arm_client(self.gcs)
         self.total = dict(resources)
         self.available = dict(resources)
         self.labels = dict(labels or {})
@@ -1662,6 +1668,7 @@ class RayletService(ChaosPartitionRpc):
 
         path = _fr.dump(reason=f"debug dump (raylet {self.node_id[:12]})")
         signaled = 0
+        pids = [os.getpid()]
         with self._workers_lock:
             workers = list(self._workers.values())
         now = time.monotonic()
@@ -1677,9 +1684,17 @@ class RayletService(ChaosPartitionRpc):
                     # /proc starttime so a recycled pid is never signaled.
                     w.proc.send_signal(signal.SIGUSR2)
                     signaled += 1
+                    pids.append(w.proc.pid)
             except OSError:
                 pass
-        return {"path": path, "workers_signaled": signaled, "dir": _fr.flight_dir()}
+        # `pids` lets the incident harvester attribute each flight dump it
+        # stages to this node (and hence this node's clock offset).
+        return {
+            "path": path,
+            "workers_signaled": signaled,
+            "dir": _fr.flight_dir(),
+            "pids": pids,
+        }
 
     def profile(self, seconds: float = 5.0) -> dict:
         """`ray-tpu debug profile`: runs the in-process sampling profiler
@@ -2729,6 +2744,11 @@ class RayletService(ChaosPartitionRpc):
                 "num_objects": self.store.num_objects(),
                 "num_spilled": n_spilled,
                 "num_workers": n_workers,
+                # Wall-clock sample for the GCS's clock-offset estimate:
+                # the incident-bundle merger shifts this node's flight/span
+                # timestamps onto the GCS clock using the offset derived
+                # from (gcs_now - wall_ts) at receive time.
+                "wall_ts": time.time(),
             }
             if self._pool is not None:
                 # Pool health rides the heartbeat: `ray-tpu status
@@ -2937,6 +2957,11 @@ class RayletService(ChaosPartitionRpc):
 
     def stop(self) -> bool:
         self._stop.set()
+        # The trigger-bus forwarder wraps self.gcs; a publish after stop
+        # (in-process raylets in tests) must not dial a dead GCS.
+        from ..observability import postmortem as _postmortem
+
+        _postmortem.disarm()
         with self._workers_lock:
             for w in self._workers.values():
                 w.mailbox.put({"type": "stop"})

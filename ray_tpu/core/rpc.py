@@ -373,10 +373,13 @@ class RpcClient:
             _send_msg(sock, payload)
             raw = _recv_msg(sock)
         except (ConnectionError, OSError):
-            # One reconnect attempt (daemon restarted).
+            # One reconnect attempt (daemon restarted). Re-apply the
+            # caller's timeout: the fresh socket defaults to blocking,
+            # which would turn a bounded call into an unbounded recv.
             sock.close()
             sock = self._new_sock(5.0)
             self._tls.sock = sock
+            sock.settimeout(timeout)
             _send_msg(sock, payload)
             raw = _recv_msg(sock)
         rid, ok, result = pickle.loads(raw)

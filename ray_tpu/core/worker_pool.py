@@ -152,6 +152,9 @@ class WorkerPoolManager:
             self._hits[tier] = self._hits.get(tier, 0) + 1
             self._last_pop = time.monotonic()
         imet.WORKER_POOL_HITS.inc(tier=tier)
+        # Ring breadcrumb: a postmortem of a slow actor launch needs to
+        # see whether the pool served warm/zygote or fell to cold spawn.
+        _flight_record("pool.pop", tier)
         self._wake.set()  # a pop leaves a hole: refill promptly
 
     def note_miss(self, mode: str) -> None:
@@ -159,6 +162,7 @@ class WorkerPoolManager:
             self._misses[mode] = self._misses.get(mode, 0) + 1
             self._last_miss = time.monotonic()
         imet.WORKER_POOL_MISSES.inc(mode=mode)
+        _flight_record("pool.miss", mode)
         self._wake.set()
 
     def set_hint(self, n: int) -> None:

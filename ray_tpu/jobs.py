@@ -71,6 +71,14 @@ class _JobSupervisor:
             rc = self.proc.wait()
         status = "SUCCEEDED" if rc == 0 else "FAILED"
         self._set_status(status, returncode=rc)
+        if status == "FAILED":
+            from .observability.postmortem import publish_trigger
+
+            publish_trigger(
+                "job.failed",
+                {"job_id": self.job_id, "returncode": rc},
+                source="jobs",
+            )
         return {"job_id": self.job_id, "status": status, "returncode": rc}
 
     def stop(self) -> bool:

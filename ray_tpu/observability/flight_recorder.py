@@ -50,12 +50,14 @@ KIND_PREFIXES = {
     "chan",      # core/channel.py reads/writes/timeouts
     "chaos",     # chaos controller injections
     "coll",      # collective rendezvous/ops
+    "incident",  # GCS trigger bus: incident open/staged lifecycle
     "lock",      # utils/lock_order.py order-cycle / long-hold reports
     "net",       # chaos network partitions (install/heal/blocked sends)
     "node",      # node lifecycle (drain notices, death, fencing, rejoin)
     "pool",      # worker-pool refills + zygote lifecycle (loss/respawn)
     "sched",     # raylet scheduler queue/dispatch
     "train",     # trainer drain/restore/elastic transitions
+    "trigger",   # anomaly trigger publishes (observability/postmortem.py)
     "watchdog",  # SLO watchdog alerts
 }
 

@@ -346,6 +346,22 @@ class Watchdog:
                     self._pending.pop(rule.name, None)
                     continue
             _flight_record("watchdog.alert", (rule.name, event["state"], value))
+            if event["state"] == "firing":
+                # Anomaly trigger: a firing SLO rule opens (or joins) an
+                # incident on the GCS bus — in-process when the watchdog
+                # runs inside the GCS, via RPC from standalone pollers.
+                from .postmortem import publish_trigger
+
+                publish_trigger(
+                    "watchdog.alert",
+                    {
+                        "rule": rule.name,
+                        "metric": rule.metric,
+                        "value": value,
+                        "threshold": rule.threshold,
+                    },
+                    source="watchdog",
+                )
             try:
                 from .logs import get_logger
 

@@ -969,6 +969,29 @@ def cmd_debug(args) -> None:
     from .observability import flight_recorder
     from .utils import state
 
+    # Dump the CLI's own ring first so the staged bundle picks it up
+    # alongside the cluster-wide harvest.
+    flight_recorder.dump(reason="debug dump (cli)")
+    try:
+        harvest = state._gcs().call("debug_harvest", timeout=45.0)
+    except Exception as e:  # noqa: BLE001
+        harvest = {"ok": False, "reason": repr(e)}
+    if harvest.get("ok") and harvest.get("bundle"):
+        print(
+            f"incident {harvest['incident']} staged "
+            f"({len(harvest.get('triggers', []))} trigger(s))"
+        )
+        print(f"bundle: {harvest['bundle']}")
+        print(f"inspect with: ray-tpu postmortem {harvest['incident']}")
+        return
+    # Trigger bus disabled (RAY_TPU_POSTMORTEM=0) or the harvest failed:
+    # fall back to the legacy loose per-node dump so the command still
+    # yields artifacts.
+    print(
+        f"warning: incident harvest unavailable "
+        f"({harvest.get('reason', 'unknown')}); falling back to raw dumps",
+        file=sys.stderr,
+    )
     dumped = []
     signaled = 0
     for n in state.list_nodes():
@@ -984,14 +1007,61 @@ def cmd_debug(args) -> None:
         if res.get("path"):
             dumped.append(res["path"])
         signaled += res.get("workers_signaled", 0)
-    own = flight_recorder.dump(reason="debug dump (cli)")
-    if own:
-        dumped.append(own)
     print(
         f"wrote {len(dumped)} flight-recorder dumps "
         f"(+{signaled} workers signaled) under {flight_recorder.flight_dir()}"
     )
     print("merge into a timeline with: ray-tpu trace --out trace.json")
+
+
+def cmd_postmortem(args) -> None:
+    """`ray-tpu postmortem [incident]`: renders the markdown incident
+    report for one staged bundle — trigger chain, suspect channel/rank/
+    node, last-N flight events per involved process (clock-skew
+    corrected), goodput/MFU impact window. With no token it lists the
+    staged bundles. Works offline: bundles are plain directories under
+    `<session>/incidents/`, no live cluster needed."""
+    from .observability import postmortem
+
+    roots = []
+    # The session dir's incidents/ when a cluster is (or recently was)
+    # around...
+    try:
+        addr = _resolve_address(args)
+        if addr and not addr.startswith("tcp://") and os.path.isdir(addr):
+            roots.append(postmortem.incidents_dir(addr))
+    except SystemExit:
+        pass
+    # ...plus the trace-dir fallback an in-process GCS stages under.
+    default_root = postmortem.incidents_dir(None)
+    if default_root not in roots:
+        roots.append(default_root)
+
+    if not args.incident:
+        rows = [b for root in roots for b in postmortem.list_bundles(root)]
+        if not rows:
+            print(f"no incident bundles under {' or '.join(roots)}")
+            return
+        for b in rows:
+            print(
+                f"{b['incident_id']}  trigger={b['trigger']}  "
+                f"triggers={b['triggers']}  nodes={b['nodes']}  {b['bundle']}"
+            )
+        print("render one with: ray-tpu postmortem <incident>")
+        return
+    bundle = postmortem.find_bundle(args.incident, roots)
+    if bundle is None:
+        raise SystemExit(
+            f"no unique incident matches {args.incident!r} under "
+            f"{' or '.join(roots)} (run `ray-tpu postmortem` to list)"
+        )
+    report = postmortem.render_report(bundle, last_n=args.last)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report)
+        print(f"wrote {args.out}")
+    else:
+        print(report)
 
 
 def cmd_dashboard(args) -> None:
@@ -1181,6 +1251,29 @@ def main(argv=None) -> None:
         help="profile duration per node (profile action)",
     )
     p.set_defaults(fn=cmd_debug)
+
+    p = sub.add_parser(
+        "postmortem",
+        help="render the markdown incident report for a staged bundle "
+        "(no argument: list incident bundles)",
+    )
+    p.add_argument(
+        "incident",
+        nargs="?",
+        default=None,
+        help="incident id, unambiguous id prefix, or bundle dir path",
+    )
+    p.add_argument("--address", default=None)
+    p.add_argument(
+        "--out", default=None, help="write the report here instead of stdout"
+    )
+    p.add_argument(
+        "--last",
+        type=int,
+        default=20,
+        help="flight events shown per involved process",
+    )
+    p.set_defaults(fn=cmd_postmortem)
 
     args = ap.parse_args(argv)
     args.fn(args)

@@ -775,6 +775,19 @@ NODE_HEARTBEAT_LAG = Gauge(
     component="gcs",
     tag_keys=("node",),
 )
+POSTMORTEM_TRIGGERS = Counter(
+    "raytpu_postmortem_triggers_total",
+    "Anomaly triggers received by the GCS trigger bus, by kind "
+    "(coalesced and fresh alike)",
+    component="gcs",
+    tag_keys=("kind",),
+)
+POSTMORTEM_INCIDENTS = Counter(
+    "raytpu_postmortem_incidents_total",
+    "Incidents opened by the trigger bus (each runs one cluster-wide "
+    "flight-ring harvest into a bundle)",
+    component="gcs",
+)
 # --- logging --------------------------------------------------------------
 LOGS_EVICTED = Counter(
     "raytpu_logs_evicted_total",

@@ -106,6 +106,19 @@ def active_alerts() -> List[Dict[str, Any]]:
     return _gcs().call("active_alerts")
 
 
+def list_incidents() -> List[Dict[str, Any]]:
+    """Incidents opened by the GCS anomaly trigger bus
+    (observability/postmortem.py): id, state (open / harvesting / staged /
+    failed), trigger kinds, coalesced-trigger count, and the staged bundle
+    path once the cluster-wide flight-ring harvest lands."""
+    return _gcs().call("list_incidents")
+
+
+def get_incident(incident_id: str) -> Optional[Dict[str, Any]]:
+    """Full record for one incident, including the trigger chain."""
+    return _gcs().call("get_incident", incident_id)
+
+
 def cluster_errors(limit: int = 100) -> List[Dict[str, Any]]:
     """Recent cluster error reports (observability/logs.py error path):
     uncaught task exceptions reported by workers and worker crashes

@@ -6,6 +6,7 @@ from . import (  # noqa: F401
     lock_discipline,
     metric_catalog,
     no_print,
+    postmortem_trigger_catalog,
     silent_swallow,
     typed_raise,
 )
