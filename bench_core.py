@@ -635,6 +635,89 @@ def bench_trigger_overhead_guard(min_time: float) -> None:
     )
 
 
+def bench_serve_engine_overhead_guard(min_time: float) -> None:
+    """LLM-engine disarmed-cost guard for NON-LLM serve deployments.
+
+    The inference engine (serve/llm/) hooks into shared serve machinery
+    at exactly two kinds of site that plain deployments also cross:
+
+    - replica lifecycle: `getattr(callable, "__llm_engine__", False)` at
+      replica init plus the same cached-attr check before each kill
+      (controller.py _prepare_replica_shutdown) — per replica event, but
+      µbenched per-call and charged per REQUEST as the worst case;
+    - batching: the per-item `isinstance(r, BaseException)` fan-out
+      check in batching._distribute, paid by every `@serve.batch` item
+      whether or not the handler ever returns an exception.
+
+    Both are µbenched disarmed (no engine deployed anywhere), converted
+    to a fraction of end-to-end serve request throughput on a trivial
+    non-LLM deployment, and pinned under the ISSUE's 1% budget."""
+    from ray_tpu import serve
+
+    class _Plain:
+        def __call__(self, x):
+            return x
+
+    plain = _Plain()
+    n_calls = 500_000
+    t0 = time.perf_counter()
+    for _ in range(n_calls):
+        getattr(plain, "__llm_engine__", False)
+    attr_ns = (time.perf_counter() - t0) / n_calls * 1e9
+
+    results = ["ok", 1, None, b"x"]
+    t0 = time.perf_counter()
+    for _ in range(n_calls // len(results)):
+        for r in results:
+            isinstance(r, BaseException)
+    item_ns = (time.perf_counter() - t0) / n_calls * 1e9
+
+    # End-to-end req/s on a trivial non-LLM deployment. local_mode — the
+    # serve data plane (handle -> replica) is in-process either way, and
+    # this matches how the LLM bench (bench_serve.py) measures.
+    rt.init(local_mode=True, num_cpus=8)
+    try:
+        dep = serve.deployment(_Plain, name="plain-guard")
+        handle = serve.run(dep.bind(), name="plain-guard", http_port=None)
+        handle.remote(b"warm").result(timeout=30)
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            n = 0
+            while time.perf_counter() - t0 < min_time:
+                handle.remote(b"x").result(timeout=30)
+                n += 1
+            best = max(best, n / (time.perf_counter() - t0))
+        req_s = best
+        serve.shutdown()
+    finally:
+        rt.shutdown()
+
+    # Worst case: a batched request crosses the lifecycle check plus a
+    # full max_batch_size fan-out of item checks (default batch size 8).
+    sites_ns = 2 * attr_ns + 8 * item_ns
+    fraction = sites_ns * 1e-9 * req_s
+    print(
+        json.dumps(
+            {
+                "metric": "serve_engine_disarmed_overhead",
+                "value": round(fraction, 6),
+                "unit": "fraction of serve request time (disarmed sites, est.)",
+                "vs_baseline": None,
+                "attr_check_ns": round(attr_ns, 1),
+                "batch_item_check_ns": round(item_ns, 1),
+                "serve_req_s": round(req_s, 1),
+            }
+        ),
+        flush=True,
+    )
+    assert fraction < 0.01, (
+        f"disarmed LLM-engine sites cost {100 * fraction:.3f}% of serve "
+        f"request throughput (budget: 1%) — attr {attr_ns:.0f} ns, item "
+        f"{item_ns:.0f} ns at {req_s:.0f} req/s"
+    )
+
+
 def bench_chaos_overhead_guard(min_time: float) -> None:
     """Chaos injection-point overhead guard.
 
@@ -1201,6 +1284,7 @@ def main():
     bench_lock_order_overhead_guard(min_time)
     bench_pool_overhead_guard(min_time)
     bench_trigger_overhead_guard(min_time)
+    bench_serve_engine_overhead_guard(min_time)
     # Very last (it asserts the >=2x ZeRO shrink contract): a failure here
     # must not mask the overhead guards above.
     bench_elastic()

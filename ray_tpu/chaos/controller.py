@@ -91,6 +91,12 @@ POINT_ACTIONS = {
     # must detect it, respawn the zygote, and rebuild the parked pool
     # while the in-flight spawn falls back to a cold Popen.
     "zygote.spawn": ("kill", "raise", "delay"),
+    # LLM engine decode loop (serve/llm/engine.py), once per decode step,
+    # detail = deployment name. `kill` SIGKILLs the replica mid-decode —
+    # the drill for "replica death must not wedge the batch or leak KV
+    # pages"; `raise` fails the step (engine fail-fasts the batch);
+    # `delay` stretches TPOT to trip latency watchdogs.
+    "serve.decode": ("kill", "raise", "delay"),
 }
 POINTS = tuple(POINT_ACTIONS)
 

@@ -548,6 +548,231 @@ def forward_pipelined(
     return jnp.einsum("bsd,dv->bsv", x, head, preferred_element_type=jnp.float32)
 
 
+# ------------------------------------------------------------ paged decode
+#
+# Inference substrate for serve/llm: the KV cache is a pool of FIXED-SIZE
+# pages shared by every sequence (vLLM's PagedAttention layout). Prefill
+# writes a sequence's k/v into the pages its block table names; decode
+# gathers those pages back, attends over them, and appends the new
+# position — all at static shapes ([B] slots, [B, P] block tables, [N]
+# pages), so ONE compiled decode step serves every batch composition and
+# the continuous-batching scheduler never triggers a recompile.
+#
+# Page 0 is reserved as a trash page: masked writes (inactive slots,
+# positions beyond a sequence's length, shared prefix pages owned by the
+# radix cache) are redirected there instead of predicated out, which
+# keeps the scatter dense and shape-stable. Trash contents are never
+# read — the attention mask stops at each sequence's length.
+
+TRASH_PAGE = 0
+
+
+def init_kv_pages(
+    cfg: TransformerConfig, num_pages: int, page_tokens: int
+) -> Dict[str, jax.Array]:
+    """Allocates the paged KV pool: k/v of shape
+    [n_layers, num_pages, page_tokens, n_kv_heads, head_dim]."""
+    shape = (cfg.n_layers, num_pages, page_tokens, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _apply_rope_rows(x, cos, sin, cfg: TransformerConfig):
+    """Rope for one position per batch row: x [B, h, d], cos/sin [B, rd/2]."""
+    c = cos[:, None, :].astype(jnp.float32)
+    s = sin[:, None, :].astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+
+    def rot(xr):
+        if cfg.rope_style == "interleaved":
+            x1, x2 = xr[..., ::2], xr[..., 1::2]
+            o1, o2 = x1 * c - x2 * s, x2 * c + x1 * s
+            return jnp.stack([o1, o2], axis=-1).reshape(xr.shape)
+        x1, x2 = jnp.split(xr, 2, axis=-1)
+        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+    rd = cfg.rotary_dim
+    if rd is not None and rd < x.shape[-1]:
+        out = jnp.concatenate([rot(xf[..., :rd]), xf[..., rd:]], axis=-1)
+    else:
+        out = rot(xf)
+    return out.astype(x.dtype)
+
+
+def _mlp(h, mp, cfg: TransformerConfig):
+    up = jnp.einsum("bsd,df->bsf", h, mp["w_up"], preferred_element_type=jnp.float32)
+    if cfg.mlp_act == "swiglu":
+        gate = jnp.einsum(
+            "bsd,df->bsf", h, mp["w_gate"], preferred_element_type=jnp.float32
+        )
+        act = (jax.nn.silu(gate) * up).astype(cfg.dtype)
+    else:
+        act = jax.nn.gelu(up).astype(cfg.dtype)
+    return jnp.einsum(
+        "bsf,fd->bsd", act, mp["w_down"], preferred_element_type=jnp.float32
+    ).astype(cfg.dtype)
+
+
+def _qkv(h, ap, cfg: TransformerConfig):
+    b, s, _ = h.shape
+    hd = cfg.head_dim
+    q = jnp.einsum("bsd,dk->bsk", h, ap["wq"], preferred_element_type=jnp.float32)
+    k = jnp.einsum("bsd,dk->bsk", h, ap["wk"], preferred_element_type=jnp.float32)
+    v = jnp.einsum("bsd,dk->bsk", h, ap["wv"], preferred_element_type=jnp.float32)
+    return (
+        q.reshape(b, s, cfg.n_heads, hd).astype(cfg.dtype),
+        k.reshape(b, s, cfg.n_kv_heads, hd).astype(cfg.dtype),
+        v.reshape(b, s, cfg.n_kv_heads, hd).astype(cfg.dtype),
+    )
+
+
+def forward_prefill(
+    params: PyTree,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    kv_pages: Dict[str, jax.Array],
+    block_table: jax.Array,
+    length: jax.Array,
+    write_from: jax.Array,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Prefill ONE sequence and write its k/v into the paged pool.
+
+    tokens [1, S] (padded to a bucket; pad is arbitrary token ids),
+    block_table [P] page indices covering positions [0, P*page_tokens),
+    length: scalar, true prompt length (<= S),
+    write_from: scalar, first position to WRITE (positions below it sit in
+      shared prefix pages owned by the radix cache — identical content was
+      already written by the original owner, so rewriting is skipped;
+      attention still covers them because the full prompt is recomputed).
+
+    Returns (last-position logits [1, vocab] fp32, updated kv_pages).
+    """
+    _, S = tokens.shape
+    T = kv_pages["k"].shape[2]
+    cos, sin = rope_tables(cfg, S)
+    x = jnp.take(params["embed"]["embedding"], tokens, axis=0)
+
+    pos = jnp.arange(S)
+    writable = (pos >= write_from) & (pos < length)
+    dest_page = jnp.where(writable, block_table[pos // T], TRASH_PAGE)
+    dest_slot = pos % T
+
+    def scan_step(x, inputs):
+        layer_params, kp, vp = inputs
+        ap = layer_params["attn"]
+        h = _norm(x, layer_params["attn_norm"]["scale"], cfg)
+        q, k, v = _qkv(h, ap, cfg)
+        q = apply_rope(q, cos, sin, cfg)
+        k = apply_rope(k, cos, sin, cfg)
+        kp = kp.at[dest_page, dest_slot].set(k[0])
+        vp = vp.at[dest_page, dest_slot].set(v[0])
+        o = _attention(q, k, v, cfg, None)
+        o = o.reshape(1, S, cfg.n_heads * cfg.head_dim)
+        attn_out = jnp.einsum(
+            "bsk,kd->bsd", o, ap["wo"], preferred_element_type=jnp.float32
+        ).astype(cfg.dtype)
+        if cfg.parallel_block:
+            mlp_in = h
+            x = x + attn_out + _mlp(mlp_in, layer_params["mlp"], cfg)
+        else:
+            x = x + attn_out
+            mlp_in = _norm(x, layer_params["mlp_norm"]["scale"], cfg)
+            x = x + _mlp(mlp_in, layer_params["mlp"], cfg)
+        return x, (kp, vp)
+
+    x, (k_new, v_new) = lax.scan(
+        scan_step, x, (params["blocks"], kv_pages["k"], kv_pages["v"])
+    )
+    x = _norm(x, params["final_norm"]["scale"], cfg)
+    h_last = jnp.take(x[0], jnp.maximum(length - 1, 0), axis=0)[None, :]
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"]["embedding"].T
+    logits = jnp.einsum("bd,dv->bv", h_last, head, preferred_element_type=jnp.float32)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def forward_decode(
+    params: PyTree,
+    tokens: jax.Array,
+    positions: jax.Array,
+    cfg: TransformerConfig,
+    kv_pages: Dict[str, jax.Array],
+    block_tables: jax.Array,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One decode step for the whole slot batch, paged attention.
+
+    tokens [B] int32 (last emitted token per slot; ignored when inactive),
+    positions [B] int32 (index the new token occupies; -1 => inactive slot),
+    block_tables [B, P] page indices per slot (trash page for unused rows).
+
+    Appends each active slot's k/v at `positions`, attends over positions
+    [0, pos], returns (logits [B, vocab] fp32, updated kv_pages). Inactive
+    slots write to the trash page and produce garbage logits the scheduler
+    ignores. Shapes are static in B/P/N: one jit serves every batch mix.
+    """
+    B = tokens.shape[0]
+    T = kv_pages["k"].shape[2]
+    P = block_tables.shape[1]
+    active = positions >= 0
+    pos = jnp.maximum(positions, 0)
+
+    cos_t, sin_t = rope_tables(cfg, P * T)
+    cos = jnp.take(cos_t, pos, axis=0)  # [B, rd/2]
+    sin = jnp.take(sin_t, pos, axis=0)
+
+    x = jnp.take(params["embed"]["embedding"], tokens, axis=0)[:, None, :]  # [B,1,d]
+    rows = jnp.arange(B)
+    dest_page = jnp.where(active, block_tables[rows, pos // T], TRASH_PAGE)
+    dest_slot = pos % T
+    rep = cfg.n_heads // cfg.n_kv_heads
+    kv_mask = jnp.arange(P * T)[None, :] <= pos[:, None]  # [B, P*T]
+
+    def scan_step(x, inputs):
+        layer_params, kp, vp = inputs
+        ap = layer_params["attn"]
+        h = _norm(x, layer_params["attn_norm"]["scale"], cfg)
+        q, k, v = _qkv(h, ap, cfg)
+        q = _apply_rope_rows(q[:, 0], cos, sin, cfg)  # [B, nh, hd]
+        k = _apply_rope_rows(k[:, 0], cos, sin, cfg)  # [B, nkv, hd]
+        kp = kp.at[dest_page, dest_slot].set(k)
+        vp = vp.at[dest_page, dest_slot].set(v[:, 0])
+        # Gather AFTER the append so the new position attends to itself.
+        kb = kp[block_tables].reshape(B, P * T, cfg.n_kv_heads, cfg.head_dim)
+        vb = vp[block_tables].reshape(B, P * T, cfg.n_kv_heads, cfg.head_dim)
+        if rep > 1:
+            kb = jnp.repeat(kb, rep, axis=2)
+            vb = jnp.repeat(vb, rep, axis=2)
+        scores = jnp.einsum(
+            "bhd,bshd->bhs", q.astype(jnp.float32), kb.astype(jnp.float32)
+        ) / math.sqrt(cfg.head_dim)
+        scores = jnp.where(kv_mask[:, None, :], scores, -jnp.inf)
+        attn = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhs,bshd->bhd", attn, vb.astype(jnp.float32))
+        o = o.reshape(B, 1, cfg.n_heads * cfg.head_dim).astype(cfg.dtype)
+        attn_out = jnp.einsum(
+            "bsk,kd->bsd", o, ap["wo"], preferred_element_type=jnp.float32
+        ).astype(cfg.dtype)
+        if cfg.parallel_block:
+            x = x + attn_out + _mlp(h, layer_params["mlp"], cfg)
+        else:
+            x = x + attn_out
+            mlp_in = _norm(x, layer_params["mlp_norm"]["scale"], cfg)
+            x = x + _mlp(mlp_in, layer_params["mlp"], cfg)
+        return x, (kp, vp)
+
+    x, (k_new, v_new) = lax.scan(
+        scan_step, x, (params["blocks"], kv_pages["k"], kv_pages["v"])
+    )
+    x = _norm(x, params["final_norm"]["scale"], cfg)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"]["embedding"].T
+    logits = jnp.einsum(
+        "bd,dv->bv", x[:, 0], head, preferred_element_type=jnp.float32
+    )
+    return logits, {"k": k_new, "v": v_new}
+
+
 def next_token_loss_pipelined(
     params: PyTree,
     tokens: jax.Array,

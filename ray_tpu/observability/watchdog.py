@@ -121,6 +121,20 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
         "for_s": 2.0,
         "description": "serve time-to-first-token p99 over its SLO",
     },
+    {
+        # KV-pool exhaustion is observable as its symptom: the LLM
+        # engine rejecting admissions with backpressure. A sustained
+        # shed rate means the page pool is undersized for the offered
+        # load (or a prefix-cache regression is burning pages).
+        "name": "kv_pool_exhausted",
+        "metric": "raytpu_serve_requests_shed_total",
+        "stat": "rate",
+        "op": ">",
+        "threshold": 0.5,
+        "window_s": 30.0,
+        "for_s": 2.0,
+        "description": "LLM engine shedding requests: KV page pool exhausted at offered load",
+    },
 ]
 
 

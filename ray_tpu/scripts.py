@@ -270,6 +270,33 @@ def cmd_status(args) -> None:
             parts.append(f"tokens/s={eff['tokens_per_s']:g}")
         if parts:
             print("efficiency: " + " ".join(parts))
+    # LLM serving gauges (serve/llm engine): decode throughput, KV page
+    # pool occupancy, prefix-cache effectiveness, shed count. Only
+    # printed when an LLM deployment has reported (pool total > 0).
+    llm = {"tok_s": 0.0, "used": 0.0, "total": 0.0, "hits": 0.0, "miss": 0.0, "shed": 0.0}
+    llm_names = {
+        "raytpu_serve_tokens_per_s": "tok_s",
+        "raytpu_kv_pages_used": "used",
+        "raytpu_kv_pages_total": "total",
+        "raytpu_prefix_cache_hits_total": "hits",
+        "raytpu_prefix_cache_misses_total": "miss",
+        "raytpu_serve_requests_shed_total": "shed",
+    }
+    try:
+        for m in metrics_records:
+            label = llm_names.get(m.get("name"))
+            if label:
+                llm[label] += float(m.get("value") or 0.0)
+    except Exception:
+        llm = {}
+    if llm and llm["total"] > 0:
+        lookups = llm["hits"] + llm["miss"]
+        hit_pct = (llm["hits"] / lookups * 100.0) if lookups else 0.0
+        print(
+            f"llm serve: tokens/s={llm['tok_s']:g} "
+            f"kv_pages={int(llm['used'])}/{int(llm['total'])} "
+            f"prefix_hits={hit_pct:.0f}% shed={int(llm['shed'])}"
+        )
     # Active SLO alerts (observability/watchdog.py): the reactive layer's
     # current verdict on the cluster.
     try:
@@ -769,6 +796,9 @@ TOP_SIGNALS = [
     ("nodes drained", "raytpu_nodes_drained_total", "value", 1.0, "", "sum"),
     ("train goodput", "raytpu_train_goodput", "value", 1.0, "", "mean"),
     ("serve req/s", "raytpu_serve_requests_total", "rate", 1.0, "/s", "sum"),
+    ("serve tok/s", "raytpu_serve_tokens_per_s", "value", 1.0, "/s", "sum"),
+    ("kv pages used", "raytpu_kv_pages_used", "value", 1.0, "", "sum"),
+    ("serve shed", "raytpu_serve_requests_shed_total", "value", 1.0, "", "sum"),
 ]
 
 _SPARK_BLOCKS = "▁▂▃▄▅▆▇█"

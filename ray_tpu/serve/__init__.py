@@ -10,6 +10,19 @@ from .handle import DeploymentHandle, DeploymentResponse, start_proxy, stop_prox
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "batch", "delete", "deployment",
-    "get_app_handle", "get_multiplexed_model_id", "multiplexed", "run",
+    "get_app_handle", "get_multiplexed_model_id", "llm", "multiplexed", "run",
     "shutdown", "start_proxy", "stop_proxy",
 ]
+
+
+def __getattr__(name):
+    # serve.llm loads lazily (PEP 562): it pulls in the model stack
+    # (jax-importing modules), which plain request/response serve users
+    # should not pay for at import time.
+    if name == "llm":
+        import importlib
+
+        mod = importlib.import_module(".llm", __name__)
+        globals()["llm"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -42,6 +42,16 @@ def get_multiplexed_model_id() -> str:
     return getattr(_request_ctx, "multiplexed_model_id", "")
 
 
+def get_request_cancel_token() -> str:
+    """The cancel token of the CURRENT streaming request ("" outside a
+    stream). Handlers that hold resources per stream (the LLM engine's
+    KV pages) key their cancellation registry on it; the replica's
+    `cancel_stream(token)` delegates to a callable method of the same
+    name so a client-side `close()` reaches the handler even while the
+    stream thread is blocked producing the next chunk."""
+    return getattr(_request_ctx, "cancel_token", "")
+
+
 class _BatchItem:
     __slots__ = ("request", "event", "result", "error")
 
@@ -65,6 +75,35 @@ def _call_fn(fn, self_obj, requests):
     if inspect.iscoroutine(out):
         out = asyncio.run(out)
     return out
+
+
+def _distribute(fn, self_obj, batch_items) -> None:
+    """Runs the handler once and routes results to each item's waiter.
+
+    Per-item error isolation: a handler that can fail one request without
+    poisoning its batchmates returns an Exception INSTANCE in that item's
+    result slot — only that waiter raises (typed: taxonomy errors pass
+    through, anything else wraps in BatchItemError), the rest of the
+    batch completes normally. Only a handler that RAISES (or returns the
+    wrong count) fails the whole batch — there are no per-item results to
+    salvage in that case."""
+    from ..exceptions import BatchItemError, RayTpuError
+
+    try:
+        results = _call_fn(fn, self_obj, [i.request for i in batch_items])
+        if len(results) != len(batch_items):
+            raise ValueError(
+                f"@serve.batch handler returned {len(results)} results "
+                f"for {len(batch_items)} requests"
+            )
+        for idx, (i, r) in enumerate(zip(batch_items, results)):
+            if isinstance(r, BaseException):
+                i.error = r if isinstance(r, RayTpuError) else BatchItemError(r, index=idx)
+            else:
+                i.result = r
+    except BaseException as e:  # noqa: BLE001
+        for i in batch_items:
+            i.error = e
 
 
 def batch(
@@ -138,17 +177,7 @@ def batch(
             # on item.event, not the cv — promote explicitly instead.)
             _promote_follower(st, fn, self_obj, max_batch_size, batch_wait_timeout_s)
             try:
-                results = _call_fn(fn, self_obj, [i.request for i in batch_items])
-                if len(results) != len(batch_items):
-                    raise ValueError(
-                        f"@serve.batch handler returned {len(results)} results "
-                        f"for {len(batch_items)} requests"
-                    )
-                for i, r in zip(batch_items, results):
-                    i.result = r
-            except BaseException as e:  # noqa: BLE001
-                for i in batch_items:
-                    i.error = e
+                _distribute(fn, self_obj, batch_items)
             finally:
                 for i in batch_items:
                     if i is not item:
@@ -193,14 +222,7 @@ def _promote_follower(st: _BatchState, fn, self_obj, max_batch_size, timeout_s) 
         if not batch_items:
             return
         try:
-            results = _call_fn(fn, self_obj, [i.request for i in batch_items])
-            if len(results) != len(batch_items):
-                raise ValueError("batch handler result count mismatch")
-            for i, r in zip(batch_items, results):
-                i.result = r
-        except BaseException as e:  # noqa: BLE001
-            for i in batch_items:
-                i.error = e
+            _distribute(fn, self_obj, batch_items)
         finally:
             for i in batch_items:
                 i.event.set()

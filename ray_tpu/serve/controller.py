@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import api
@@ -60,6 +61,11 @@ class Replica:
         # watchdog rule consume.
         self._m_ttft = imet.SERVE_TTFT.labels(deployment=app_name)
         self._m_qdepth = imet.SERVE_QUEUE_DEPTH.labels(deployment=app_name)
+        # Engine-bearing callables (serve/llm deployment.py) get a
+        # graceful teardown before kill; one cached attr check is the
+        # whole cost for everyone else (pinned <1% by bench_core's
+        # serve-engine overhead guard).
+        self._llm_engine = bool(getattr(self._callable, "__llm_engine__", False))
         # Streaming responses: generator outputs run in a background thread
         # into a bounded queue, pulled chunk-wise by the caller (reference:
         # replica.py handle_request_streaming over the streaming generator
@@ -67,6 +73,37 @@ class Replica:
         # same incremental delivery + backpressure without a new channel
         # primitive).
         self._streams: Dict[str, Any] = {}
+        # Client-side stream cancellation (handle-path close()): tokens
+        # arrive over a separate actor call, the drain loop checks
+        # between chunks. Bounded so tokens for already-finished (or
+        # never-started) streams cannot accumulate.
+        self._stream_cancels: "OrderedDict[str, bool]" = OrderedDict()
+
+    def cancel_stream(self, token: str) -> bool:
+        """Best-effort cancel of a streaming request by its client-side
+        token. Generic half: mark the token so handle_request_stream's
+        drain loop closes the handler generator at the next chunk
+        boundary. Handler half: a callable exposing `cancel_stream`
+        (the LLM deployment) is told immediately — it can interrupt the
+        in-flight producer (engine.cancel frees KV pages within one
+        decode step) instead of waiting for the next chunk."""
+        with self._lock:
+            self._stream_cancels[token] = True
+            while len(self._stream_cancels) > 256:
+                self._stream_cancels.popitem(last=False)
+        fn = getattr(self._callable, "cancel_stream", None)
+        if fn is not None:
+            try:
+                fn(token)
+            except Exception:  # lint: swallow-ok(cancel is best-effort; stream may already be gone)
+                pass
+        return True
+
+    def _stream_cancelled(self, token) -> bool:
+        if token is None:
+            return False
+        with self._lock:
+            return token in self._stream_cancels
 
     def handle_request(self, method: str, args, kwargs, context=None):
         import asyncio
@@ -94,7 +131,8 @@ class Replica:
             from .batching import set_request_context
 
             set_request_context(
-                multiplexed_model_id=(context or {}).get("multiplexed_model_id", "")
+                multiplexed_model_id=(context or {}).get("multiplexed_model_id", ""),
+                cancel_token="",  # pool threads are reused; clear stream state
             )
             fn = self._callable if method == "__call__" else getattr(self._callable, method)
             if method == "__call__" and not callable(self._callable):
@@ -206,11 +244,13 @@ class Replica:
             self._m_qdepth.set(self._ongoing)
         self._m_requests.inc()
         req_t0 = _time.perf_counter()
+        cancel_token = (context or {}).get("cancel_token")
         try:
             from .batching import set_request_context
 
             set_request_context(
-                multiplexed_model_id=(context or {}).get("multiplexed_model_id", "")
+                multiplexed_model_id=(context or {}).get("multiplexed_model_id", ""),
+                cancel_token=cancel_token or "",
             )
             fn = self._callable if method == "__call__" else getattr(self._callable, method)
             if method == "__call__" and not callable(self._callable):
@@ -237,6 +277,17 @@ class Replica:
                         except StopAsyncIteration:
                             pass
                     elif inspect.isgenerator(out):
+                        # A cancel that raced ahead of this task starting
+                        # (client closed before the stream was scheduled)
+                        # stops before the first chunk. Re-delegate: the
+                        # handler registered its cancel hook inside fn()
+                        # above, AFTER the early cancel_stream call ran —
+                        # and close() on a never-started generator skips
+                        # its finally, so this is the only cancel path.
+                        if self._stream_cancelled(cancel_token):
+                            self.cancel_stream(cancel_token)
+                            out.close()
+                            return
                         first = next(out, _STREAM_EXHAUSTED)
                     else:
                         first = out  # non-generator handler: a one-chunk stream
@@ -252,7 +303,18 @@ class Replica:
                         except StopAsyncIteration:
                             break
                 elif inspect.isgenerator(out):
-                    yield from out
+                    while True:
+                        # Checked between chunks: close() lands at the
+                        # next chunk boundary even for handlers with no
+                        # cancel_stream hook of their own.
+                        if self._stream_cancelled(cancel_token):
+                            out.close()
+                            break
+                        try:
+                            chunk = next(out)
+                        except StopIteration:
+                            break
+                        yield chunk
             finally:
                 # One close for every exit: first-chunk failure, a consumer
                 # abandoning the stream (GeneratorExit at any yield), or a
@@ -263,6 +325,8 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
                 self._m_qdepth.set(self._ongoing)
+                if cancel_token:
+                    self._stream_cancels.pop(cancel_token, None)
             self._m_latency.observe((_time.perf_counter() - req_t0) * 1e3)
 
     def next_chunks(self, stream_id: str, max_n: int = 8, timeout: float = 2.0):
@@ -311,6 +375,26 @@ class Replica:
 
     def health_check(self) -> bool:
         return True
+
+    def prepare_shutdown(self) -> bool:
+        """Called by the controller before a graceful kill. LLM replicas
+        tear down their resident engine here — the feed channels close so
+        attached clients fail fast (ActorDiedError) instead of waiting
+        out a read timeout, and in-flight sequences release their KV
+        pages instead of dying mid-decode."""
+        if self._llm_engine:
+            try:
+                self._callable.shutdown_engine()
+            except Exception:  # lint: swallow-ok(kill follows regardless; engine may be half-built)
+                pass
+        return True
+
+
+def _prepare_replica_shutdown(replica, timeout: float = 5.0) -> None:
+    try:
+        api.get(replica.prepare_shutdown.remote(), timeout=timeout)
+    except Exception:  # lint: swallow-ok(replica may already be dead)
+        pass
 
 
 class ServeController:
@@ -380,6 +464,7 @@ class ServeController:
             self._app_gen[app_name] = self._app_gen.get(app_name, 0) + 1
             self._version += 1
         for r in old_replicas:
+            _prepare_replica_shutdown(r)
             try:
                 api.kill(r)
             except Exception:  # lint: swallow-ok(replica may already be dead)
@@ -399,6 +484,7 @@ class ServeController:
             self._app_gen[app_name] = self._app_gen.get(app_name, 0) + 1
             self._version += 1
         for r in replicas:
+            _prepare_replica_shutdown(r)
             try:
                 api.kill(r)
             except Exception:  # lint: swallow-ok(replica may already be dead)
@@ -426,6 +512,7 @@ class ServeController:
             except Exception:
                 break  # actor already dead
             time.sleep(0.25)
+        _prepare_replica_shutdown(replica)
         try:
             api.kill(replica)
         except Exception:  # lint: swallow-ok(replica may already be dead)

@@ -119,9 +119,10 @@ class DeploymentResponseGenerator:
     the streaming generator protocol of _raylet.pyx:281 — here the same
     layering, serve on top of core streaming)."""
 
-    def __init__(self, ref_gen, on_done):
+    def __init__(self, ref_gen, on_done, on_cancel=None):
         self._gen = ref_gen
         self._on_done = on_done
+        self._on_cancel = on_cancel
         self._finished = False
 
     def _finish(self):
@@ -133,6 +134,8 @@ class DeploymentResponseGenerator:
         return self
 
     def __next__(self):
+        if self._gen is None:
+            raise StopIteration
         # The outstanding counter holds until the stream is drained, so
         # pow-2 routing sees long-lived streams as load.
         try:
@@ -145,6 +148,32 @@ class DeploymentResponseGenerator:
         except BaseException:
             self._finish()
             raise
+
+    def close(self):
+        """Cancels the stream server-side (client disconnect). The
+        replica's cancel_stream stops the handler at the next chunk
+        boundary — and immediately for handlers with their own
+        cancel_stream hook (the LLM engine frees the request's KV pages
+        and batch slot within one decode step); unconsumed chunk objects
+        free when the underlying ref generator is dropped."""
+        if self._finished:
+            self._gen = None  # already drained/errored: nothing to cancel
+            return
+        if self._on_cancel is not None:
+            try:
+                self._on_cancel()
+            except Exception:  # lint: swallow-ok(cancel is best-effort; replica may be dead already)
+                pass
+        self._gen = None  # drops the ref generator -> stream_done frees
+        self._finish()
+
+    def __del__(self):
+        # An abandoned stream (for-loop break, dropped handle) must not
+        # keep producing server-side.
+        try:
+            self.close()
+        except Exception:  # lint: swallow-ok(__del__ during interpreter teardown)
+            pass
 
 
 class DeploymentHandle:
@@ -253,11 +282,21 @@ class DeploymentHandle:
             else None
         )
         if self._stream:
+            import uuid as _uuid
+
+            # Client-generated: travels in the request context so a later
+            # close() can name this stream to the replica.
+            cancel_token = _uuid.uuid4().hex
+            context = {**(context or {}), "cancel_token": cancel_token}
             with span_cm or _tracing.null_span():
                 ref_gen = replica.handle_request_stream.options(
                     num_returns="streaming"
                 ).remote(self._method, args, kwargs, context)
-            return DeploymentResponseGenerator(ref_gen, done)
+
+            def cancel():
+                replica.cancel_stream.remote(cancel_token)
+
+            return DeploymentResponseGenerator(ref_gen, done, on_cancel=cancel)
         resp_ctx = None
         with span_cm or _tracing.null_span() as sp:
             ref = replica.handle_request.remote(self._method, args, kwargs, context)

@@ -1,0 +1,196 @@
+"""Model adapters for the LLM engine.
+
+The engine (engine.py) schedules against a tiny protocol — an object
+with `prefill(prompt, pages, cached_tokens) -> token` and
+`decode(last_tokens, positions, block_tables) -> tokens` plus the pool
+geometry attributes — so the scheduler is testable without JAX and the
+JAX path stays a thin adapter over models/transformer.py.
+
+PagedLM is the real path: one jitted decode step at static shapes
+([max_slots] tokens, [max_slots, max_pages_per_seq] block tables, the
+whole page pool) serves every batch composition; prefill compiles per
+power-of-two page bucket, so compile count is O(log max_seq), not
+O(distinct prompt lengths).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from .kv_cache import TRASH_PAGE
+
+
+class StubModel:
+    """Deterministic, JAX-free model for scheduler/chaos tests and the
+    engine's disarmed-cost bench: next token = (last + 1) % vocab.
+    `step_delay_s` simulates decode latency so tests can observe
+    continuous batching join/leave behaviour."""
+
+    def __init__(
+        self,
+        *,
+        vocab: int = 256,
+        max_slots: int = 4,
+        max_pages_per_seq: int = 8,
+        step_delay_s: float = 0.0,
+    ):
+        self.vocab = vocab
+        self.max_slots = max_slots
+        self.max_pages_per_seq = max_pages_per_seq
+        self.step_delay_s = step_delay_s
+        self.prefill_calls = 0
+        self.decode_calls = 0
+
+    def prefill(self, prompt: Sequence[int], pages: Sequence[int], cached_tokens: int) -> int:
+        self.prefill_calls += 1
+        return (sum(prompt) + 1) % self.vocab
+
+    def decode(self, last_tokens, positions, block_tables) -> List[int]:
+        self.decode_calls += 1
+        if self.step_delay_s:
+            import time
+
+            time.sleep(self.step_delay_s)
+        return [
+            (int(t) + 1) % self.vocab if int(p) >= 0 else 0
+            for t, p in zip(last_tokens, positions)
+        ]
+
+
+class PagedLM:
+    """Paged-KV inference adapter over models/transformer.py.
+
+    Owns the physical page pool (init_kv_pages) and the compiled
+    prefill/decode steps; the engine owns the page bookkeeping and passes
+    block tables in. Greedy sampling runs inside the jit (argmax) so only
+    int32 tokens cross the host boundary per step.
+    """
+
+    def __init__(
+        self,
+        cfg=None,
+        params=None,
+        *,
+        seed: int = 0,
+        num_pages: int = 128,
+        page_tokens: int = 16,
+        max_slots: int = 4,
+        max_pages_per_seq: int = 8,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from ...models import transformer as tfm
+
+        self._jax, self._jnp, self._tfm = jax, jnp, tfm
+        if cfg is None:
+            cfg = tfm.tiny(attn_impl="naive", dtype=jnp.float32)
+        self.cfg = cfg
+        if params is None:
+            params = tfm.init_params(jax.random.PRNGKey(seed), cfg)
+        self.params = params
+        self.vocab = cfg.vocab_size
+        self.num_pages = num_pages
+        self.page_tokens = page_tokens
+        self.max_slots = max_slots
+        self.max_pages_per_seq = max_pages_per_seq
+        self.kv = tfm.init_kv_pages(cfg, num_pages, page_tokens)
+        self._decode_jit = None
+        self._prefill_jits: Dict[int, Any] = {}
+        # One lock around every jitted call: the engine loop is the only
+        # steady-state caller, but tests poke prefill directly.
+        self._mu = threading.Lock()
+
+    # ------------------------------------------------------------- compile
+
+    def _donate(self, argnums):
+        # Buffer donation keeps the page pool from doubling per step on
+        # TPU; the CPU backend does not implement donation and would warn
+        # on every call.
+        if self._jax.default_backend() == "cpu":
+            return ()
+        return argnums
+
+    def _get_decode(self):
+        if self._decode_jit is None:
+            cfg, tfm = self.cfg, self._tfm
+
+            def step(params, tokens, positions, kv, block_tables):
+                logits, kv = tfm.forward_decode(
+                    params, tokens, positions, cfg, kv, block_tables
+                )
+                return self._jnp.argmax(logits, axis=-1).astype(self._jnp.int32), kv
+
+            self._decode_jit = self._jax.jit(step, donate_argnums=self._donate((3,)))
+        return self._decode_jit
+
+    def _get_prefill(self, n_pages_bucket: int):
+        fn = self._prefill_jits.get(n_pages_bucket)
+        if fn is None:
+            cfg, tfm = self.cfg, self._tfm
+
+            def step(params, tokens, kv, block_table, length, write_from):
+                logits, kv = tfm.forward_prefill(
+                    params, tokens, cfg, kv, block_table, length, write_from
+                )
+                return self._jnp.argmax(logits[0], axis=-1).astype(self._jnp.int32), kv
+
+            fn = self._jax.jit(step, donate_argnums=self._donate((2,)))
+            self._prefill_jits[n_pages_bucket] = fn
+        return fn
+
+    def _bucket_pages(self, n_pages: int) -> int:
+        return min(self.max_pages_per_seq, 1 << max(0, math.ceil(math.log2(n_pages))))
+
+    # --------------------------------------------------------------- steps
+
+    def prefill(self, prompt: Sequence[int], pages: Sequence[int], cached_tokens: int) -> int:
+        import numpy as np
+
+        T = self.page_tokens
+        n_pages = max(1, -(-len(prompt) // T))
+        bucket = self._bucket_pages(n_pages)
+        S = bucket * T
+        toks = np.zeros((1, S), dtype=np.int32)
+        toks[0, : len(prompt)] = np.asarray(prompt, dtype=np.int32)
+        bt = np.full((bucket,), TRASH_PAGE, dtype=np.int32)
+        bt[: len(pages)] = np.asarray(pages, dtype=np.int32)
+        fn = self._get_prefill(bucket)
+        with self._mu:
+            tok, self.kv = fn(
+                self.params,
+                toks,
+                self.kv,
+                bt,
+                np.int32(len(prompt)),
+                np.int32(cached_tokens),
+            )
+            return int(tok)
+
+    def decode(self, last_tokens, positions, block_tables) -> List[int]:
+        import numpy as np
+
+        B, P = self.max_slots, self.max_pages_per_seq
+        toks = np.zeros((B,), dtype=np.int32)
+        pos = np.full((B,), -1, dtype=np.int32)
+        bts = np.full((B, P), TRASH_PAGE, dtype=np.int32)
+        toks[: len(last_tokens)] = np.asarray(last_tokens, dtype=np.int32)
+        pos[: len(positions)] = np.asarray(positions, dtype=np.int32)
+        for i, row in enumerate(block_tables):
+            bts[i, : len(row)] = np.asarray(row, dtype=np.int32)
+        fn = self._get_decode()
+        with self._mu:
+            out, self.kv = fn(self.params, toks, pos, self.kv, bts)
+            return [int(t) for t in np.asarray(out)]
+
+
+def tiny_paged_lm(**kw) -> PagedLM:
+    """Builder for deployments/tests: the CI-sized transformer on the
+    paged decode path (picklable by reference for serve deploy blobs)."""
+    return PagedLM(**kw)
+
+
+def stub_model(**kw) -> StubModel:
+    return StubModel(**kw)

@@ -639,6 +639,48 @@ SERVE_QUEUE_DEPTH = Gauge(
     component="serve",
     tag_keys=("deployment",),
 )
+SERVE_TOKENS_PER_S = Gauge(
+    "raytpu_serve_tokens_per_s",
+    "LLM engine decode throughput (emitted tokens/s, per deployment)",
+    component="serve",
+    tag_keys=("deployment",),
+)
+SERVE_TPOT = Histogram(
+    "raytpu_serve_tpot_ms",
+    "LLM engine time-per-output-token (decode step latency), by deployment",
+    component="serve",
+    tag_keys=("deployment",),
+)
+KV_PAGES_USED = Gauge(
+    "raytpu_kv_pages_used",
+    "KV-cache pages currently referenced by live sequences",
+    component="serve",
+    tag_keys=("deployment",),
+)
+KV_PAGES_TOTAL = Gauge(
+    "raytpu_kv_pages_total",
+    "KV-cache pages in the pool (capacity, constant per engine)",
+    component="serve",
+    tag_keys=("deployment",),
+)
+PREFIX_CACHE_HITS = Counter(
+    "raytpu_prefix_cache_hits_total",
+    "Prompt pages served from the hashed-prefix radix index",
+    component="serve",
+    tag_keys=("deployment",),
+)
+PREFIX_CACHE_MISSES = Counter(
+    "raytpu_prefix_cache_misses_total",
+    "Prompt pages that required a fresh physical page",
+    component="serve",
+    tag_keys=("deployment",),
+)
+SERVE_REQUESTS_SHED = Counter(
+    "raytpu_serve_requests_shed_total",
+    "LLM requests rejected with backpressure (pool exhausted / queue full)",
+    component="serve",
+    tag_keys=("deployment",),
+)
 DATA_OP_TASKS = Counter(
     "raytpu_data_op_tasks_total",
     "Data streaming-executor tasks submitted, by operator",

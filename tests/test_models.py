@@ -192,3 +192,58 @@ class TestMoE:
         y, aux = run(sharded, x)  # XLA compiles the expert all_to_all
         y_ref, _ = moe_apply(params, np.asarray(x), cfg)
         np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), rtol=2e-4, atol=2e-5)
+
+
+def test_paged_decode_matches_full_forward():
+    """The serving decode path (paged KV cache, one compiled step per
+    batch composition) must be NUMERICALLY the same model as training
+    `forward`: greedy decode token-for-token, including a prefix-cached
+    second sequence (its prefill skips re-writing shared pages) and an
+    inactive batch slot (position -1, writes redirected to the trash
+    page)."""
+    from ray_tpu.serve.llm.kv_cache import PagedKVAllocator
+    from ray_tpu.serve.llm.model import PagedLM
+
+    cfg = tfm.tiny(attn_impl="naive", dtype=jnp.float32, remat=False)
+    T = 8
+    lm = PagedLM(cfg, seed=0, num_pages=32, page_tokens=T, max_slots=2,
+                 max_pages_per_seq=8)
+    alloc = PagedKVAllocator(32, T)
+
+    def gold(prompt, n):
+        seq = list(prompt)
+        out = []
+        for _ in range(n):
+            logits = tfm.forward(lm.params, jnp.asarray([seq], jnp.int32), cfg)
+            nxt = int(jnp.argmax(logits[0, len(seq) - 1]))
+            out.append(nxt)
+            seq.append(nxt)
+        return out
+
+    def paged(prompt, n, sp, slot, co_pos=None, co_tok=None, co_pages=None):
+        """Decode `n` tokens for `sp` in `slot`; the other slot either
+        idles (position -1) or replays a fixed co-resident sequence."""
+        got = [lm.prefill(prompt, sp.pages, sp.cached_tokens)]
+        alloc.commit(sp, prompt)
+        while len(got) < n:
+            pos = len(prompt) + len(got) - 1
+            if pos >= sp.num_pages * T:
+                alloc.extend(sp)
+            toks = [0, 0]
+            poss = [-1, -1]
+            tabs = [[], []]
+            toks[slot], poss[slot], tabs[slot] = got[-1], pos, sp.pages
+            got.append(int(lm.decode(toks, poss, tabs)[slot]))
+        return got
+
+    # 13-token prompt: crosses a page boundary mid-prompt AND during
+    # decode (position 16 needs a third page via alloc.extend).
+    p1 = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9]
+    assert paged(p1, 8, alloc.allocate(p1), slot=0) == gold(p1, 8)
+
+    # Prefix-cached sequence in the OTHER slot: shares p1's first full
+    # page physically (prefill skips re-writing it), must still match.
+    p2 = p1[:T] + [7, 7]
+    sp2 = alloc.allocate(p2)
+    assert sp2.cached_tokens == T  # radix hit on the committed page
+    assert paged(p2, 5, sp2, slot=1) == gold(p2, 5)

@@ -1,0 +1,522 @@
+"""LLM inference engine tests: paged KV allocator, continuous-batching
+scheduler, cancellation/backpressure semantics, the channel feed path,
+and the serve-facing deployment (serve/llm/*).
+
+Scheduler tests run on StubModel (JAX-free, deterministic: prefill =
+(sum(prompt)+1) % vocab, decode = last+1) so they exercise pure
+scheduling logic fast; decode-vs-forward numerics live in
+test_models.py::test_paged_decode_matches_full_forward.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    BackpressureError,
+    BatchItemError,
+    KVPoolExhaustedError,
+    RayTpuError,
+)
+from ray_tpu.serve.llm import (
+    EngineConfig,
+    InferenceEngine,
+    LLMClient,
+    PagedKVAllocator,
+    StubModel,
+)
+from ray_tpu.utils import internal_metrics as imet
+
+
+def _wait_for(predicate, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        last = predicate()
+        if last:
+            return last
+        time.sleep(interval)
+    return last
+
+
+@pytest.fixture
+def rt():
+    import ray_tpu as rtpu
+    from ray_tpu import serve
+
+    rtpu.shutdown()
+    rtpu.init(local_mode=True, num_cpus=8)
+    yield rtpu
+    serve.shutdown()
+    rtpu.shutdown()
+
+
+# ------------------------------------------------------------- allocator
+
+
+def test_allocator_basic_alloc_release():
+    a = PagedKVAllocator(num_pages=8, page_tokens=4)
+    assert a.total_pages == 7  # page 0 is the trash page
+    assert a.pages_for(1) == 1 and a.pages_for(4) == 1 and a.pages_for(5) == 2
+    sp = a.allocate(list(range(10)))  # 3 pages
+    assert sp.num_pages == 3
+    assert 0 not in sp.pages  # trash page never handed out
+    assert a.used_pages() == 3
+    a.release(sp)
+    assert a.used_pages() == 0
+    assert a.free_pages() == 7
+    a.release(sp)  # idempotent (cancel path races the finish path)
+    assert a.free_pages() == 7
+
+
+def test_allocator_exhaustion_typed_and_atomic():
+    a = PagedKVAllocator(num_pages=4, page_tokens=4)  # 3 usable pages
+    sp = a.allocate(list(range(8)))  # 2 pages
+    with pytest.raises(KVPoolExhaustedError) as ei:
+        a.allocate(list(range(100, 108)))  # needs 2, only 1 free
+    assert isinstance(ei.value, BackpressureError)
+    assert ei.value.needed_pages == 2 and ei.value.free_pages == 1
+    # Failed allocation reserved nothing.
+    assert a.used_pages() == 2
+    ok = a.allocate(list(range(200, 204)))  # 1 page still fits
+    a.release(ok)
+    a.release(sp)
+
+
+def test_allocator_prefix_reuse_and_eviction():
+    a = PagedKVAllocator(num_pages=10, page_tokens=4)
+    system = list(range(8))  # two full pages of shared prefix
+    s1 = a.allocate(system + [50, 51])
+    a.commit(s1, system + [50, 51])
+    shared = s1.pages[:2]
+
+    # Live sharing: a second prompt with the same prefix maps onto the
+    # same physical pages and only pays for its private tail.
+    s2 = a.allocate(system + [60])
+    assert s2.pages[:2] == shared
+    assert s2.cached_tokens == 8
+    assert a.prefix_hits == 2
+    a.release(s1)
+    assert a.used_pages() == 3  # shared pages still referenced by s2
+    a.release(s2)
+
+    # Released-but-indexed pages revive from the eviction LRU for free.
+    s3 = a.allocate(system + [70])
+    assert s3.pages[:2] == shared
+    a.release(s3)
+
+    # Allocation pressure evicts cold cached pages instead of shedding.
+    big = a.allocate(list(range(100, 136)))  # 9 pages = whole pool
+    assert big.num_pages == 9
+    a.release(big)
+
+
+def test_allocator_commit_concurrent_twin_keeps_private_pages():
+    a = PagedKVAllocator(num_pages=8, page_tokens=4)
+    p = list(range(4))
+    s1 = a.allocate(p)
+    s2 = a.allocate(p)  # before s1 commits: no index entry yet, fresh page
+    assert s1.pages != s2.pages
+    a.commit(s1, p)
+    a.commit(s2, p)  # loses the race; its page stays private
+    a.release(s1)
+    a.release(s2)
+    s3 = a.allocate(p)
+    assert s3.pages == s1.pages  # the committed winner is the shared copy
+    a.release(s3)
+
+
+# ---------------------------------------------------------------- engine
+
+
+def _collect(engine, prompt, max_new):
+    return list(engine.generate(prompt, max_new))
+
+
+def _stub_tokens(prompt, n, vocab=256):
+    first = (sum(prompt) + 1) % vocab
+    return [(first + i) % vocab for i in range(n)]
+
+
+def test_engine_stream_completes_and_frees_pages():
+    eng = InferenceEngine(
+        StubModel(), EngineConfig(page_tokens=4, pool_pages=16), name="t-basic"
+    )
+    try:
+        out = _collect(eng, [1, 2, 3], 6)
+        assert out == _stub_tokens([1, 2, 3], 6)
+        assert _wait_for(lambda: eng.alloc.used_pages() == 0)
+        # The satellite contract: pool occupancy is observable via the
+        # raytpu_kv_pages_used gauge, not just engine internals.
+        g = imet.KV_PAGES_USED.labels(deployment="t-basic")
+        assert g._value == 0.0
+        assert imet.KV_PAGES_TOTAL.labels(deployment="t-basic")._value == 15.0
+    finally:
+        eng.close()
+
+
+def test_engine_continuous_join_leave():
+    """Token-level scheduling: a short request submitted mid-flight joins
+    the running batch and finishes while the long one is still decoding."""
+    eng = InferenceEngine(
+        StubModel(max_slots=2, step_delay_s=0.02),
+        EngineConfig(page_tokens=4, pool_pages=32),
+        name="t-join",
+    )
+    try:
+        events = []
+
+        def sink_for(tag):
+            def sink(ev, val):
+                events.append((tag, ev, val))
+
+            return sink
+
+        eng.submit([1, 2], 25, sink=sink_for("long"))
+        _wait_for(lambda: any(e[0] == "long" and e[1] == "tok" for e in events))
+        eng.submit([3], 3, sink=sink_for("short"))
+        assert _wait_for(
+            lambda: ("short", "done", "stop") in events, timeout=20.0
+        ), events
+        done_idx = events.index(("short", "done", "stop"))
+        # The long request decoded before AND after the short one's whole
+        # lifetime — they shared decode steps, not a request-level queue.
+        long_toks = [i for i, e in enumerate(events) if e[0] == "long" and e[1] == "tok"]
+        assert any(i < done_idx for i in long_toks)
+        assert ("long", "done", "stop") not in events[: done_idx + 1]
+        _wait_for(lambda: ("long", "done", "stop") in events, timeout=30.0)
+        assert [v for t, e, v in events if t == "short" and e == "tok"] == _stub_tokens([3], 3)
+    finally:
+        eng.close()
+
+
+def test_engine_cancellation_frees_pages_within_one_step():
+    eng = InferenceEngine(
+        StubModel(step_delay_s=0.02),
+        EngineConfig(page_tokens=4, pool_pages=16),
+        name="t-cancel",
+    )
+    try:
+        it = eng.generate([1, 2, 3, 4, 5], 25)  # long-ish stream
+        next(it)
+        next(it)
+        assert eng.alloc.used_pages() > 0
+        it.close()  # client disconnect: generator finalizer cancels
+        # Pages and the batch slot free within ~one decode step.
+        assert _wait_for(lambda: eng.alloc.used_pages() == 0, timeout=5.0)
+        assert _wait_for(lambda: eng.stats()["running"] == 0, timeout=5.0)
+        assert imet.KV_PAGES_USED.labels(deployment="t-cancel")._value == 0.0
+    finally:
+        eng.close()
+
+
+def test_engine_shed_typed_backpressure():
+    eng = InferenceEngine(
+        StubModel(step_delay_s=0.05),
+        EngineConfig(page_tokens=4, pool_pages=4),  # 3 usable pages
+        name="t-shed",
+    )
+    try:
+        it = eng.generate([1] * 8, 2)  # holds 2 of 3 pages
+        with pytest.raises(KVPoolExhaustedError):
+            eng.submit([2] * 8, 2, sink=lambda ev, v: None)  # needs 2 pages
+        assert eng.shed_total == 1
+        assert eng.stats()["shed_total"] == 1
+        list(it)  # drain; pages return
+        assert _wait_for(lambda: eng.alloc.used_pages() == 0)
+    finally:
+        eng.close()
+
+
+def test_engine_queue_full_sheds():
+    eng = InferenceEngine(
+        StubModel(),
+        EngineConfig(page_tokens=4, pool_pages=16, max_queue=0),
+        name="t-q",
+    )
+    try:
+        with pytest.raises(BackpressureError):
+            eng.submit([1], 1, sink=lambda ev, v: None)
+        assert eng.shed_total == 1
+        assert eng.alloc.used_pages() == 0  # shed before reservation
+    finally:
+        eng.close()
+
+
+def test_engine_validation_errors():
+    eng = InferenceEngine(
+        StubModel(max_pages_per_seq=2),
+        EngineConfig(page_tokens=4, pool_pages=16),
+        name="t-val",
+    )
+    try:
+        with pytest.raises(ValueError):
+            eng.submit([], 4, sink=lambda ev, v: None)
+        with pytest.raises(ValueError):  # 8 positions max for 2 pages of 4
+            eng.submit([1, 2, 3, 4], 8, sink=lambda ev, v: None)
+    finally:
+        eng.close()
+
+
+def test_engine_eos_stops_stream():
+    # Stub emits consecutive ints; make the 3rd token the eos.
+    prompt = [5]
+    toks = _stub_tokens(prompt, 8)
+    eng = InferenceEngine(
+        StubModel(),
+        EngineConfig(page_tokens=4, pool_pages=16, eos_token=toks[2]),
+        name="t-eos",
+    )
+    try:
+        assert _collect(eng, prompt, 8) == toks[:3]  # eos token included, then stop
+    finally:
+        eng.close()
+
+
+def test_engine_chaos_decode_fault_fail_fast_then_recovers():
+    """The chaos drill (engine half): an injected decode fault fails the
+    in-flight batch with a TYPED error, frees its pages, and the loop
+    keeps serving — no wedge, no leak."""
+    from ray_tpu import chaos
+
+    eng = InferenceEngine(
+        StubModel(step_delay_s=0.01),
+        EngineConfig(page_tokens=4, pool_pages=16),
+        name="t-chaos",
+    )
+    try:
+        chaos.configure([{"point": "serve.decode", "action": "raise", "times": 1}])
+        with pytest.raises(RayTpuError):
+            _collect(eng, [1, 2, 3], 10)
+        assert _wait_for(lambda: eng.alloc.used_pages() == 0, timeout=5.0)
+        # Next request (chaos rule exhausted) succeeds on the same loop.
+        assert _collect(eng, [1, 2, 3], 4) == _stub_tokens([1, 2, 3], 4)
+    finally:
+        chaos.disable()
+        eng.close()
+
+
+def test_engine_close_fails_inflight_typed():
+    eng = InferenceEngine(
+        StubModel(step_delay_s=0.05),
+        EngineConfig(page_tokens=4, pool_pages=16),
+        name="t-close",
+    )
+    it = eng.generate([1, 2], 25)
+    next(it)
+    eng.close()
+    with pytest.raises(RayTpuError):
+        list(it)
+    assert eng.alloc.used_pages() == 0
+
+
+# ------------------------------------------------- serve deployment (e2e)
+
+
+def _deploy_stub(serve, name="llm", **model_kw):
+    from ray_tpu.serve.llm import llm_deployment
+    from ray_tpu.serve.llm.model import stub_model
+
+    app = llm_deployment(
+        stub_model,
+        name=name,
+        model_kwargs=model_kw,
+        engine_config=EngineConfig(page_tokens=4, pool_pages=32),
+    )
+    return serve.run(app, name=name, http_port=None)
+
+
+def _replica_for(rt, name):
+    from ray_tpu.serve.controller import get_or_create_controller
+
+    controller = get_or_create_controller()
+    _, replicas = rt.get(controller.get_replicas.remote(name))
+    assert replicas
+    return replicas[0]
+
+
+def _engine_stats(rt, replica):
+    return rt.get(replica.handle_request.remote("engine_stats", (), {}))
+
+
+def test_llm_deployment_streaming_e2e(rt):
+    from ray_tpu import serve
+
+    handle = _deploy_stub(serve, name="llm-stream")
+    gen = handle.options(stream=True).remote([1, 2, 3], 5)
+    assert list(gen) == _stub_tokens([1, 2, 3], 5)
+    replica = _replica_for(rt, "llm-stream")
+    stats = _wait_for(
+        lambda: (s := _engine_stats(rt, replica))["kv"]["used_pages"] == 0 and s
+    )
+    assert stats["tokens_emitted"] >= 5
+    serve.shutdown()
+
+
+def test_handle_stream_close_cancels_and_frees_pages(rt):
+    """Serve-handle path cancellation: a client calling close() on the
+    streaming response generator (or dropping it) must interrupt the
+    in-flight request — KV pages and batch slot free within one decode
+    step, and the engine must NOT decode the remaining tokens."""
+    from ray_tpu import serve
+
+    handle = _deploy_stub(serve, name="llm-hclose", step_delay_s=0.02)
+    replica = _replica_for(rt, "llm-hclose")
+
+    gen = handle.options(stream=True).remote([1, 2, 3], 25)
+    got = [next(gen), next(gen)]
+    assert got == _stub_tokens([1, 2, 3], 25)[:2]
+    gen.close()
+
+    assert _wait_for(
+        lambda: (s := _engine_stats(rt, replica))["running"] == 0
+        and s["kv"]["used_pages"] == 0,
+        timeout=10.0,
+    )
+    # Proves interruption, not just completion: at 20ms/step the full 25
+    # tokens take ~0.5s; the cancel lands after ~2-3 steps.
+    stats = _engine_stats(rt, replica)
+    assert stats["tokens_emitted"] < 25, stats
+
+    # closing again is idempotent; the deployment keeps serving.
+    gen.close()
+    assert list(handle.options(stream=True).remote([9], 3)) == _stub_tokens([9], 3)
+    serve.shutdown()
+
+
+def test_llm_feed_client_roundtrip_and_cancel(rt):
+    from ray_tpu import serve
+
+    handle = _deploy_stub(serve, name="llm-feed", step_delay_s=0.01)
+    del handle
+    replica = _replica_for(rt, "llm-feed")
+    client = LLMClient("llm-feed")
+    try:
+        # Round trip: same tokens the handle path would produce.
+        assert list(client.generate([4, 5], 4)) == _stub_tokens([4, 5], 4)
+
+        # Mid-stream cancel: dropping the iterator sends a cancel and the
+        # replica frees the pages + slot within a decode step.
+        it = client.generate([6, 7, 8], 25)
+        next(it)
+        it.close()
+        assert _wait_for(
+            lambda: _engine_stats(rt, replica)["kv"]["used_pages"] == 0, timeout=10.0
+        )
+        assert _engine_stats(rt, replica)["running"] == 0
+
+        # The feed stays usable after a cancel.
+        assert list(client.generate([9], 3)) == _stub_tokens([9], 3)
+    finally:
+        client.close()
+    serve.shutdown()
+
+
+def test_feed_client_death_frees_pages(rt):
+    """Chaos drill, client half: a client that VANISHES mid-stream (no
+    polite detach) must not leak replica-side pages — the response
+    channel's closure cancels its outstanding sequences."""
+    from ray_tpu import serve
+
+    _deploy_stub(serve, name="llm-cdie", step_delay_s=0.02)
+    replica = _replica_for(rt, "llm-cdie")
+    client = LLMClient("llm-cdie")
+    it = client.generate([1, 2, 3], 25)
+    next(it)
+    assert _engine_stats(rt, replica)["kv"]["used_pages"] > 0
+    # Simulate client death: tear the response channel down abruptly.
+    client.resp_reader.close()
+    client.req_writer.close()
+    assert _wait_for(
+        lambda: _engine_stats(rt, replica)["kv"]["used_pages"] == 0, timeout=15.0
+    )
+    assert _engine_stats(rt, replica)["running"] == 0
+    serve.shutdown()
+
+
+def test_feed_replica_death_fails_fast(rt):
+    """Chaos drill, replica half: when the replica side goes away
+    mid-stream the client gets a TYPED ActorDiedError promptly (never a
+    hang), and later generate() calls fail fast too."""
+    from ray_tpu import serve
+
+    _deploy_stub(serve, name="llm-rdie", step_delay_s=0.02)
+    replica = _replica_for(rt, "llm-rdie")
+    client = LLMClient("llm-rdie")
+    it = client.generate([1, 2], 25)
+    next(it)
+    # Replica death as the wire sees it: engine + feed channels torn down.
+    rt.get(replica.handle_request.remote("shutdown_engine", (), {}))
+    with pytest.raises((ActorDiedError, RayTpuError)):
+        deadline = time.monotonic() + 15.0
+        for _ in it:
+            assert time.monotonic() < deadline, "stream wedged after replica death"
+    with pytest.raises(ActorDiedError):
+        for _ in client.generate([3], 2):
+            pass
+    serve.shutdown()
+
+
+def test_llm_deployment_concurrent_clients(rt):
+    from ray_tpu import serve
+
+    handle = _deploy_stub(serve, name="llm-many")
+    results = {}
+
+    def call(i):
+        results[i] = list(handle.options(stream=True).remote([i], 4))
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    for i in range(6):
+        assert results[i] == _stub_tokens([i], 4), i
+    serve.shutdown()
+
+
+# ----------------------------------------------- batching error isolation
+
+
+def test_serve_batch_per_item_error_isolation(rt):
+    """One bad request in a batch fails ONLY its own caller (typed), the
+    rest of the batch completes (serve/batching.py _distribute)."""
+    from ray_tpu import serve
+
+    @serve.deployment(max_ongoing_requests=16)
+    class Half:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.5)
+        def __call__(self, items):
+            return [
+                ValueError(f"odd input {i}") if i % 2 else i * 10 for i in items
+            ]
+
+    handle = serve.run(Half.bind(), name="peritem")
+    resps = [handle.remote(i) for i in range(4)]
+    assert resps[0].result(timeout=30) == 0
+    assert resps[2].result(timeout=30) == 20
+    for odd in (1, 3):
+        with pytest.raises(BatchItemError) as ei:
+            resps[odd].result(timeout=30)
+        assert "odd input" in str(ei.value)
+    serve.shutdown()
+
+
+def test_serve_batch_handler_raise_still_fails_batch(rt):
+    from ray_tpu import serve
+
+    @serve.deployment(max_ongoing_requests=16)
+    class Boom:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.3)
+        def __call__(self, items):
+            raise RuntimeError("whole batch down")
+
+    handle = serve.run(Boom.bind(), name="boom")
+    resps = [handle.remote(i) for i in range(3)]
+    for r in resps:
+        with pytest.raises(Exception, match="whole batch down"):
+            r.result(timeout=30)
+    serve.shutdown()

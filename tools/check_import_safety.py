@@ -90,6 +90,17 @@ REQUIRED = {
     "ray_tpu.core.worker_pool",
     "ray_tpu.core.zygote",
     "ray_tpu.core.worker_proc",
+    # The LLM serving stack: serve/__init__ lazy-loads it (PEP 562) so
+    # plain serve users never import it, but LLM replicas import the
+    # whole package at deployment build — an import-time backend init
+    # here would wedge replica startup (jax use must stay inside the
+    # PagedLM constructor, not at module scope).
+    "ray_tpu.serve.llm",
+    "ray_tpu.serve.llm.engine",
+    "ray_tpu.serve.llm.kv_cache",
+    "ray_tpu.serve.llm.model",
+    "ray_tpu.serve.llm.deployment",
+    "ray_tpu.serve.llm.feed",
 }
 
 
