@@ -154,6 +154,56 @@ def timeit(name: str, fn, multiplier: int = 1, min_time: float = 2.0):
     return name, rate
 
 
+def bench_gcs_shard_overhead_guard(min_time: float) -> None:
+    """GCS table-sharding overhead guard.
+
+    Sharding exists for 1000-raylet clusters; a 3-node dev box must not
+    pay for it. Pinning RAY_TPU_GCS_SHARDS=1 (the old single-lock
+    layout, structurally) must stay within 2% of the shipped default on
+    end-to-end dispatch — i.e. the per-shard routing, lock, and WAL
+    machinery is free when there's nothing to spread. INTERLEAVED
+    1/default boots with best-of per config (same drift rationale as
+    the history guard)."""
+    import os
+
+    key = "RAY_TPU_GCS_SHARDS"
+    saved = os.environ.get(key)
+    rates = {"single": 0.0, "sharded": 0.0}
+    try:
+        for _trial in range(3):
+            for label, flag in (("single", "1"), ("sharded", None)):
+                if flag is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = flag
+                rt.init(num_cpus=8, num_workers=2, object_store_memory=256 << 20)
+                rates[label] = max(rates[label], _sync_dispatch_rate(min_time))
+                rt.shutdown()
+    finally:
+        if saved is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = saved
+    ratio = rates["sharded"] / rates["single"] if rates["single"] else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "gcs_shard_overhead",
+                "value": round(ratio, 3),
+                "unit": "x (sharded-default/single-shard sync dispatch)",
+                "vs_baseline": None,
+                "on_ops_s": round(rates["sharded"], 1),
+                "off_ops_s": round(rates["single"], 1),
+            }
+        ),
+        flush=True,
+    )
+    assert ratio >= 0.98, (
+        f"GCS sharding costs {100 * (1 - ratio):.1f}% of no-op dispatch "
+        f"at small scale (budget: 2%) — {rates}"
+    )
+
+
 def _sync_dispatch_rate(min_time: float) -> float:
     """Best-of-3 synchronous no-op dispatch rate on a fresh cluster."""
     @rt.remote
@@ -1280,6 +1330,7 @@ def main():
     bench_chaos_overhead_guard(min_time)
     bench_rpc_chaos_overhead_guard(min_time)
     bench_history_watchdog_overhead_guard(min_time)
+    bench_gcs_shard_overhead_guard(min_time)
     bench_logging_overhead_guard(min_time)
     bench_lock_order_overhead_guard(min_time)
     bench_pool_overhead_guard(min_time)

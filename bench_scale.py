@@ -332,6 +332,46 @@ def large_object(gb: float) -> None:
     del out, ref
 
 
+def control_plane_sim(quick: bool) -> None:
+    """Control-plane scale proof (tools/scale_sim.py): ~1000 thin
+    heartbeat-only raylet stubs over real RPC against ONE real GCS —
+    sharded+batched registration vs the single-lock per-node baseline,
+    heartbeat fan-in p99, and delta-pubsub vs full-snapshot delivery
+    p99. Runs as a subprocess: the sim must set its heartbeat-timeout
+    env BEFORE ray_tpu imports, and its GCS must not share this
+    process's runtime state."""
+    import os
+    import subprocess
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    n = 200 if quick else 1000
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "scale_sim.py"),
+         "--nodes", str(n), "--json"],
+        capture_output=True, text=True, cwd=root, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": root + os.pathsep + os.environ.get("PYTHONPATH", "")},
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"scale_sim failed (rc={proc.returncode}):\n{proc.stderr[-2000:]}"
+        )
+    sim = json.loads(proc.stdout.strip().splitlines()[-1])
+    emit("sim_registrations_per_s", sim["registrations_per_s"], "regs/s",
+         nodes=sim["nodes"], shards=sim["shards"],
+         speedup_vs_single_lock=sim["speedup_sharded_vs_single"])
+    emit("sim_registrations_per_s_single_lock",
+         sim["registrations_per_s_single_lock"], "regs/s", nodes=sim["nodes"])
+    emit("sim_heartbeat_p99_ms", sim["heartbeat"]["p99_ms"], "ms",
+         p50_ms=sim["heartbeat"]["p50_ms"], n=sim["heartbeat"]["n"])
+    emit("sim_pubsub_delta_p99_ms", sim["pubsub_delta"]["p99_ms"], "ms",
+         p50_ms=sim["pubsub_delta"]["p50_ms"])
+    emit("sim_pubsub_snapshot_p99_ms", sim["pubsub_snapshot"]["p99_ms"], "ms",
+         p50_ms=sim["pubsub_snapshot"]["p50_ms"])
+    emit("sim_heartbeat_bytes", sim["heartbeat_payload"]["delta_bytes"], "B",
+         full_bytes=sim["heartbeat_payload"]["full_bytes"])
+
+
 def main():
     quick = "--quick" in sys.argv
     rt.init(num_cpus=16, num_workers=2, object_store_memory=3 << 30)
@@ -348,6 +388,8 @@ def main():
     # Traced launch-path breakdown runs AFTER the clean-throughput phase
     # (its own cluster, tracing armed at daemon spawn).
     actor_launch_profile(n=10 if quick else 40)
+    # Control-plane scale sim last: own subprocess, own GCS, no cluster.
+    control_plane_sim(quick)
 
 
 if __name__ == "__main__":

@@ -17,12 +17,15 @@ the resource view used for spillback decisions.
 from __future__ import annotations
 
 import collections
+import contextlib
 import os
 import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from . import gcs_shards as _gsh
+from . import heartbeat as _hb
 from ..chaos.net import ChaosPartitionRpc
 from ..observability import postmortem as _postmortem
 from ..exceptions import (
@@ -57,13 +60,17 @@ class GcsService(ChaosPartitionRpc):
         self,
         snapshot_path: Optional[str] = None,
         session_dir: Optional[str] = None,
+        shards: Optional[int] = None,
     ):
         self._lock = lock_order.tracked_rlock("gcs.state")
         self._snapshot_path = snapshot_path
         self._session_dir = session_dir or (
             os.path.dirname(snapshot_path) if snapshot_path else None
         )
-        self._nodes: Dict[str, dict] = {}
+        # Hot tables — nodes (+ their registration epochs), actors, and
+        # the object directory (+ its borrow/free companions) — live in
+        # N key-hashed shards, each with its own lock and WAL segment
+        # (gcs_shards.py). Everything below stays on the control lock.
         # Monotonic per-node registration epochs (persisted): every
         # register_node stamps the next epoch for that node id, and every
         # raylet-originated RPC carries the epoch it was granted. A node
@@ -71,11 +78,16 @@ class GcsService(ChaosPartitionRpc):
         # partition's zombie) is FENCED: its calls are rejected with
         # StaleNodeEpochError until it re-registers as a fresh
         # incarnation — there is no silent resurrection path.
-        self._node_epochs: Dict[str, int] = {}
-        self._actors: Dict[str, dict] = {}
+        self._nshards = _gsh.resolve_shard_count(shards)
+        self._shards = _gsh.make_shards(self._nshards)
         self._named: Dict[Tuple[str, str], str] = {}
-        self._objects: Dict[str, Set[str]] = {}
         self._kv: Dict[str, bytes] = {}
+        # Freshness-window cache for full node-table dumps: at 1000
+        # nodes, concurrent `status`/autoscaler/dashboard pollers would
+        # each rebuild the full view; single-flighted behind this lock
+        # (engaged only at scale — small clusters always read fresh).
+        self._view_lock = lock_order.tracked_lock("gcs.nodeview")
+        self._view_cache: Tuple[float, List[dict]] = (0.0, [])
         self._pgs: Dict[str, dict] = {}
         # Task table fed by batched raylet events (reference:
         # gcs_task_manager.h task events; used for owner-side failure
@@ -99,10 +111,10 @@ class GcsService(ChaosPartitionRpc):
         # reply's pool_hint so raylets pre-size their warm worker pools
         # BEFORE the launch storm arrives. (value, expires_at_monotonic).
         self._demand_forecast: Tuple[int, float] = (0, 0.0)
-        self._borrows: Dict[str, int] = {}
-        self._deferred_free: Set[str] = set()
+        # Borrow counts / free tombstones / deferred frees live on the
+        # OBJECT's shard (same partition as its location set); only the
+        # time-ordered free queue stays on the control lock.
         self._free_queue: List[Tuple[float, List[str]]] = []
-        self._freed: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
         self._raylet_clients: Dict[str, Any] = {}
         self._user_metrics: Dict[Tuple, dict] = {}
         # Runtime-internal metrics table (reference: metric_defs.cc
@@ -142,6 +154,14 @@ class GcsService(ChaosPartitionRpc):
             self._load_snapshot()
             self._replay_wal()
             self._wal_f = open(self._wal_path, "ab")
+            for sh in self._shards:
+                sh.wal_open(_gsh.wal_segment_path(snapshot_path, sh.index))
+                sh.recount_alive()
+            # Snapshot right after replay: every replayed segment (legacy
+            # single-file WALs, segments written under a different shard
+            # count) is folded into one durable snapshot and truncated, so
+            # all live segments were written under THIS shard count.
+            self._save_snapshot()
         self._health = threading.Thread(target=self._health_loop, daemon=True)
         self._health.start()
         # SLO watchdog: rules over the history stream, alerts onto the
@@ -187,6 +207,84 @@ class GcsService(ChaosPartitionRpc):
         "_deferred_free",
     )
 
+    # Tables split across the key-hashed shards; the snapshot stores them
+    # MERGED under these names (format-compatible with pre-sharding
+    # snapshots), and _load_snapshot scatters them back by key.
+    _NODE_SHARDED = ("_nodes", "_node_epochs")
+    _ACTOR_SHARDED = ("_actors",)
+    _OBJECT_SHARDED = ("_objects", "_freed", "_borrows", "_deferred_free")
+    _SHARD_ATTRS = {
+        "_nodes": "nodes",
+        "_node_epochs": "node_epochs",
+        "_actors": "actors",
+        "_objects": "objects",
+        "_freed": "freed",
+        "_borrows": "borrows",
+        "_deferred_free": "deferred_free",
+    }
+
+    # ---------------------------------------------------- shard routing
+    def _node_shard(self, node_id: str) -> _gsh.GcsShard:
+        return self._shards[_gsh.shard_index(node_id, self._nshards)]
+
+    def _actor_shard(self, actor_id: str) -> _gsh.GcsShard:
+        return self._shards[_gsh.shard_index(actor_id, self._nshards)]
+
+    def _object_shard(self, oid_hex: str) -> _gsh.GcsShard:
+        return self._shards[_gsh.shard_index(oid_hex, self._nshards)]
+
+    @contextlib.contextmanager
+    def _locked(self, sh: _gsh.GcsShard):
+        """Shard lock acquisition with the wait measured — the direct
+        residual-contention signal (raytpu_gcs_shard_lock_wait_ms).
+        Lock order: gcs.state may be held on entry; shard locks nest in
+        ascending index only; NEVER take gcs.state while holding one."""
+        t0 = time.perf_counter()
+        with sh.lock:
+            imet.GCS_SHARD_LOCK_WAIT.observe(
+                (time.perf_counter() - t0) * 1e3, shard=str(sh.index)
+            )
+            yield sh
+
+    def _alive_nodes(self) -> int:
+        """O(shards) alive count off the per-shard counters — lock-free
+        (a torn read across counters is at worst one heartbeat stale)."""
+        return sum(sh.alive_count for sh in self._shards)
+
+    def _node_count(self) -> int:
+        return sum(len(sh.nodes) for sh in self._shards)
+
+    def _nodes_view_for(self, nids) -> Dict[str, dict]:
+        """Resolves node ids to {sock, store, alive} in one pass, grouped
+        by shard (ascending, one lock each) — the cross-shard join used
+        by object-location reads and the free path."""
+        by_shard: Dict[int, List[str]] = {}
+        for nid in set(nids):
+            by_shard.setdefault(
+                _gsh.shard_index(nid, self._nshards), []
+            ).append(nid)
+        out: Dict[str, dict] = {}
+        for idx in sorted(by_shard):
+            sh = self._shards[idx]
+            with self._locked(sh):
+                for nid in by_shard[idx]:
+                    n = sh.nodes.get(nid)
+                    if n is not None:
+                        out[nid] = {
+                            "sock": n["sock"],
+                            "store": n["store"],
+                            "alive": n["alive"],
+                        }
+        return out
+
+    def _node_sock(self, node_id: str, alive_only: bool = True) -> Optional[str]:
+        sh = self._node_shard(node_id)
+        with self._locked(sh):
+            n = sh.nodes.get(node_id)
+            if n is None or (alive_only and not n["alive"]):
+                return None
+            return n["sock"]
+
     def _load_snapshot(self) -> None:
         import pickle
 
@@ -196,34 +294,44 @@ class GcsService(ChaosPartitionRpc):
         except (OSError, EOFError, pickle.UnpicklingError):
             return
         with self._lock:
-            for name in self._PERSISTED:
+            for name in ("_named", "_pgs", "_kv"):
                 if name in data:
                     setattr(self, name, data[name])
-            now = time.monotonic()
-            for n in self._nodes.values():
-                # Grace: loaded nodes get a fresh heartbeat window; truly
-                # dead ones expire through the normal health check.
-                n["last_hb"] = now
             for pg in self._pgs.values():
                 # A snapshot taken mid-reschedule must resume as
                 # RESCHEDULING: only that state is retried.
                 if pg.get("state") == "REPLANNING":
                     pg["state"] = "RESCHEDULING"
-
-    _WAL_TABLES = ("_nodes", "_node_epochs", "_actors", "_named", "_pgs", "_kv")
+        now = time.monotonic()
+        for name, attr in self._SHARD_ATTRS.items():
+            merged = data.get(name)
+            if merged is None:
+                continue
+            if isinstance(merged, (set, frozenset)):
+                for key in merged:
+                    sh = self._shards[_gsh.shard_index(key, self._nshards)]
+                    with sh.lock:
+                        getattr(sh, attr).add(key)
+                continue
+            for key, value in merged.items():
+                if name == "_nodes":
+                    # Grace: loaded nodes get a fresh heartbeat window;
+                    # truly dead ones expire through the health check.
+                    value["last_hb"] = now
+                sh = self._shards[_gsh.shard_index(key, self._nshards)]
+                with sh.lock:
+                    getattr(sh, attr)[key] = value
 
     def _persist_delta(self, table: str, key, value) -> None:
-        """Appends one control-table delta to the WAL (value=None deletes).
-        Called with self._lock held by the mutating handler, so snapshot
-        truncation (also under the lock) can never lose a record."""
+        """Appends one CONTROL-table delta (_named/_pgs/_kv) to the meta
+        WAL (value=None deletes). Called with self._lock held by the
+        mutating handler, so snapshot truncation (also under the lock)
+        can never lose a record. Sharded-table deltas go through the
+        owning shard's wal_append under that shard's lock instead."""
         if self._wal_f is None:
             return
-        import copy
-        import pickle
-
         try:
-            rec = pickle.dumps((table, key, copy.copy(value)))
-            self._wal_f.write(len(rec).to_bytes(4, "little") + rec)
+            self._wal_f.write(_gsh.encode_wal_record(table, key, value))
             self._wal_f.flush()
         except Exception as e:
             # Durability is best-effort between snapshots, but a WAL that
@@ -234,32 +342,43 @@ class GcsService(ChaosPartitionRpc):
                 self._wal_warned = True
                 _log.warning("WAL append failed; durability degraded to snapshots: %r", e)
 
-    def _replay_wal(self) -> None:
-        import pickle
+    _WAL_TABLES = (
+        "_nodes", "_node_epochs", "_actors", "_named", "_pgs", "_kv",
+    )
 
-        try:
-            with open(self._wal_path, "rb") as f:
-                data = f.read()
-        except OSError:
-            return
-        pos = 0
-        with self._lock:
-            while pos + 4 <= len(data):
-                n = int.from_bytes(data[pos:pos + 4], "little")
-                pos += 4
-                if pos + n > len(data):
-                    break  # torn tail write: ignore
-                try:
-                    table, key, value = pickle.loads(data[pos:pos + n])
-                except Exception:
-                    break
-                pos += n
-                if table in self._WAL_TABLES:
-                    d = getattr(self, table)
-                    if value is None:
-                        d.pop(key, None)
-                    else:
-                        d[key] = value
+    def _replay_wal(self) -> None:
+        """Replays every WAL file over the loaded snapshot: the meta
+        segment (control tables; also sharded-table records from a
+        legacy pre-sharding boot) and all shard segments. Records route
+        by table+key under the CURRENT shard count, so a shard-count
+        change between boots cannot misfile state."""
+        for path in _gsh.discover_wal_paths(self._snapshot_path):
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                continue
+            for table, key, value in _gsh.iter_wal_records(data):
+                if table not in self._WAL_TABLES:
+                    continue
+                attr = self._SHARD_ATTRS.get(table)
+                if attr is not None:
+                    sh = self._shards[_gsh.shard_index(key, self._nshards)]
+                    with sh.lock:
+                        d = getattr(sh, attr)
+                        if value is None:
+                            d.pop(key, None)
+                        else:
+                            d[key] = value
+                            if table == "_nodes":
+                                value["last_hb"] = time.monotonic()
+                else:
+                    with self._lock:
+                        d = getattr(self, table)
+                        if value is None:
+                            d.pop(key, None)
+                        else:
+                            d[key] = value
 
     def _save_snapshot(self) -> None:
         if not self._snapshot_path:
@@ -267,16 +386,16 @@ class GcsService(ChaosPartitionRpc):
         import copy
         import pickle
 
+        data: Dict[str, Any] = {}
         with self._lock:
             # Shallow-ish copies under the lock (fast pointer copies);
             # the expensive pickle runs OUTSIDE so RPCs aren't stalled.
-            data = {
-                name: copy.copy(getattr(self, name)) for name in self._PERSISTED
-            }
-            # Remember how much of the WAL this snapshot covers; rotation
-            # happens only AFTER the snapshot is durably on disk (wiping
-            # first would lose every delta if the pickle/write fails or
-            # the process dies in between).
+            for name in ("_named", "_pgs", "_kv"):
+                data[name] = copy.copy(getattr(self, name))
+            # Remember how much of each WAL this snapshot covers;
+            # rotation happens only AFTER the snapshot is durably on
+            # disk (wiping first would lose every delta if the pickle/
+            # write fails or the process dies in between).
             wal_covered = 0
             if self._wal_f is not None:
                 try:
@@ -284,6 +403,18 @@ class GcsService(ChaosPartitionRpc):
                     wal_covered = self._wal_f.tell()
                 except Exception:
                     wal_covered = 0
+        for name in self._SHARD_ATTRS:
+            data[name] = set() if name == "_deferred_free" else {}
+        shard_covered: List[int] = []
+        for sh in self._shards:
+            with self._locked(sh):
+                for name, attr in self._SHARD_ATTRS.items():
+                    part = getattr(sh, attr)
+                    if isinstance(part, set):
+                        data[name] |= part
+                    else:
+                        data[name].update(part)
+                shard_covered.append(sh.wal_covered())
         try:
             blob = pickle.dumps(data)
         except Exception:
@@ -292,8 +423,6 @@ class GcsService(ChaosPartitionRpc):
         try:
             with open(tmp, "wb") as f:
                 f.write(blob)
-            import os
-
             os.replace(tmp, self._snapshot_path)
         except OSError:
             return  # retried next interval; WAL still intact
@@ -317,41 +446,58 @@ class GcsService(ChaosPartitionRpc):
                         self._wal_f = open(self._wal_path, "ab")
                     except Exception:
                         self._wal_f = None
+        for sh, covered in zip(self._shards, shard_covered):
+            if covered:
+                with self._locked(sh):
+                    sh.wal_rotate(covered)
 
     # ------------------------------------------------------------- nodes
-    def register_node(
+    def _register_node_locked(
         self,
+        sh: _gsh.GcsShard,
         node_id: str,
         sock_path: str,
         store_path: str,
         resources: dict,
-        labels: Optional[dict] = None,
-    ) -> dict:
+        labels: Optional[dict],
+        wal_out: List[Tuple[str, Any, Any]],
+    ) -> int:
+        """Inserts one node record (owning shard's lock held), collecting
+        its WAL deltas into `wal_out` so batched registration can group-
+        commit them. Returns the granted epoch."""
+        # A fresh epoch per registration: a fenced/partitioned
+        # incarnation rejoining gets a new number, and everything
+        # still stamped with the old one stays rejected.
+        epoch = sh.node_epochs.get(node_id, 0) + 1
+        sh.node_epochs[node_id] = epoch
+        prev = sh.nodes.get(node_id)
+        if prev is None or not prev["alive"]:
+            sh.alive_count += 1
+        sh.nodes[node_id] = {
+            "sock": sock_path,
+            "store": store_path,
+            "resources": dict(resources),
+            "available": dict(resources),
+            "labels": dict(labels or {}),
+            "alive": True,
+            "epoch": epoch,
+            "last_hb": time.monotonic(),
+        }
+        wal_out.append(("_node_epochs", node_id, epoch))
+        wal_out.append(("_nodes", node_id, sh.nodes[node_id]))
+        return epoch
+
+    def _post_register(self, registered: List[Tuple[str, int]]) -> None:
+        """Shared fan-out after node registration(s): stranded-gang and
+        stranded-actor retries, lifecycle events, node-table deltas."""
         with self._lock:
-            # A fresh epoch per registration: a fenced/partitioned
-            # incarnation rejoining gets a new number, and everything
-            # still stamped with the old one stays rejected.
-            epoch = self._node_epochs.get(node_id, 0) + 1
-            self._node_epochs[node_id] = epoch
-            self._persist_delta("_node_epochs", node_id, epoch)
-            self._nodes[node_id] = {
-                "sock": sock_path,
-                "store": store_path,
-                "resources": dict(resources),
-                "available": dict(resources),
-                "labels": dict(labels or {}),
-                "alive": True,
-                "epoch": epoch,
-                "last_hb": time.monotonic(),
-            }
-            self._persist_delta("_nodes", node_id, self._nodes[node_id])
-            n_alive = sum(1 for n in self._nodes.values() if n["alive"])
             retry_gangs = [
                 pg_id
                 for pg_id, pg in self._pgs.items()
                 if pg.get("state") == "RESCHEDULING"
             ]
-        _frec_record("node.added", (node_id[:12], epoch))
+        for node_id, epoch in registered:
+            _frec_record("node.added", (node_id[:12], epoch))
         if retry_gangs:
             # A new host may complete a slice: retry stranded gangs.
             threading.Thread(
@@ -364,23 +510,82 @@ class GcsService(ChaosPartitionRpc):
         # Capacity-wait subscribers (JaxTrainer's elastic renegotiation)
         # block on node_events instead of polling the node table: a join
         # is as much a lifecycle event as a drain.
-        self.pubsub_publish(
-            "node_events",
-            {"event": "node_added", "node_id": node_id, "epoch": epoch, "ts": time.time()},
-        )
+        for node_id, epoch in registered:
+            self.pubsub_publish(
+                "node_events",
+                {"event": "node_added", "node_id": node_id, "epoch": epoch,
+                 "ts": time.time()},
+            )
+            self._publish_node_delta(node_id)
+
+    def register_node(
+        self,
+        node_id: str,
+        sock_path: str,
+        store_path: str,
+        resources: dict,
+        labels: Optional[dict] = None,
+    ) -> dict:
+        sh = self._node_shard(node_id)
+        wal: List[Tuple[str, Any, Any]] = []
+        with self._locked(sh):
+            epoch = self._register_node_locked(
+                sh, node_id, sock_path, store_path, resources, labels, wal
+            )
+            sh.wal_append_many(wal)
+        n_alive = self._alive_nodes()
+        self._post_register([(node_id, epoch)])
         return {"ok": True, "nodes": n_alive, "epoch": epoch}
 
+    def register_nodes(self, specs: List[dict]) -> List[dict]:
+        """Batched registration: ONE RPC admits a storm of nodes. The
+        batch is partitioned per shard and applied under per-shard locks
+        — never a global one — with each shard's WAL deltas landing as a
+        single group commit (one write+flush per shard touched, not two
+        per node). Spec keys: node_id, sock, store, resources, labels."""
+        by_shard: Dict[int, List[dict]] = {}
+        for s in specs:
+            by_shard.setdefault(
+                _gsh.shard_index(s["node_id"], self._nshards), []
+            ).append(s)
+        epochs: Dict[str, int] = {}
+        for idx in sorted(by_shard):
+            sh = self._shards[idx]
+            wal: List[Tuple[str, Any, Any]] = []
+            with self._locked(sh):
+                for s in by_shard[idx]:
+                    epochs[s["node_id"]] = self._register_node_locked(
+                        sh,
+                        s["node_id"],
+                        s["sock"],
+                        s["store"],
+                        s.get("resources") or {},
+                        s.get("labels"),
+                        wal,
+                    )
+                sh.wal_append_many(wal)
+        n_alive = self._alive_nodes()
+        self._post_register([(s["node_id"], epochs[s["node_id"]]) for s in specs])
+        return [
+            {"ok": True, "nodes": n_alive, "epoch": epochs[s["node_id"]]}
+            for s in specs
+        ]
+
     # ------------------------------------------------------------ fencing
-    def _mark_fenced_locked(self, node_id: str, n: dict) -> bool:
-        """Stamps the FENCED state on a dead/stale node record (lock
-        held). Returns True on the first fencing of this incarnation —
-        the caller publishes/counts outside the lock."""
+    def _mark_fenced_locked(
+        self, sh: _gsh.GcsShard, node_id: str, n: dict
+    ) -> bool:
+        """Stamps the FENCED state on a dead/stale node record (owning
+        shard's lock held). Returns True on the first fencing of this
+        incarnation — the caller publishes/counts outside the lock."""
         if n.get("fenced"):
             return False
+        if n["alive"]:
+            sh.alive_count -= 1
         n["alive"] = False  # fencing implies dead; never resurrect in place
         n["fenced"] = True
         n["fenced_ts"] = time.time()
-        self._persist_delta("_nodes", node_id, n)
+        sh.wal_append("_nodes", node_id, n)
         return True
 
     def _reject_stale_node(
@@ -391,24 +596,29 @@ class GcsService(ChaosPartitionRpc):
         Every raylet-originated mutation path calls this first — a
         partitioned node that was declared dead keeps *executing*, but
         nothing it says moves cluster state until it re-registers as a
-        fresh incarnation (no silent resurrection)."""
-        with self._lock:
-            n = self._nodes.get(node_id)
+        fresh incarnation (no silent resurrection). The verdict is judged
+        under the NODE's shard lock — a cross-shard mutation (say an
+        actor write whose fencing record lives elsewhere) takes the node
+        shard here, releases it, then takes the mutation's own shard:
+        sequential, never nested."""
+        sh = self._node_shard(node_id)
+        with self._locked(sh):
+            n = sh.nodes.get(node_id)
             if n is None:
                 return  # unknown node: the caller's NACK path handles it
-            verdict = self._fence_verdict_locked(node_id, n, epoch)
+            verdict = self._fence_verdict_locked(sh, node_id, n, epoch)
         if verdict is not None:
             self._raise_fenced(node_id, epoch, verdict, context)
 
     def _fence_verdict_locked(
-        self, node_id: str, n: dict, epoch: Optional[int]
+        self, sh: _gsh.GcsShard, node_id: str, n: dict, epoch: Optional[int]
     ) -> Optional[Tuple[Optional[int], bool]]:
         """Judges one raylet-originated call against the membership record
-        (lock held — callers that also mutate the record do both under ONE
-        acquisition, so the verdict and the mutation cannot interleave
-        with a concurrent re-registration). Returns None when the caller
-        is current, else (current_epoch, newly_fenced) with a dead-marked
-        record stamped FENCED."""
+        (owning shard's lock held — callers that also mutate the record do
+        both under ONE acquisition, so the verdict and the mutation cannot
+        interleave with a concurrent re-registration). Returns None when
+        the caller is current, else (current_epoch, newly_fenced) with a
+        dead-marked record stamped FENCED."""
         cur = n.get("epoch")
         stale = epoch is not None and cur is not None and epoch != cur
         if n["alive"] and not stale:
@@ -420,7 +630,7 @@ class GcsService(ChaosPartitionRpc):
             # incarnation talking after its successor re-registered:
             # the caller is rejected, but the CURRENT incarnation's
             # record must not be touched.
-            newly_fenced = self._mark_fenced_locked(node_id, n)
+            newly_fenced = self._mark_fenced_locked(sh, node_id, n)
         return (cur, newly_fenced)
 
     def _raise_fenced(
@@ -459,6 +669,7 @@ class GcsService(ChaosPartitionRpc):
                 {"node_id": node_id[:12], "epoch": epoch, "current": cur},
                 source="gcs",
             )
+            self._publish_node_delta(node_id)
         raise StaleNodeEpochError(
             node_id,
             claimed_epoch=epoch,
@@ -469,38 +680,46 @@ class GcsService(ChaosPartitionRpc):
     def heartbeat(
         self,
         node_id: str,
-        available: dict,
+        available: Optional[dict] = None,
         stats: Optional[dict] = None,
         epoch: Optional[int] = None,
     ) -> dict:
+        """The 1 Hz fan-in. Payloads are DELTAS (core/heartbeat.py):
+        `available` is None when unchanged, `stats` carries only changed
+        keys (a full resend sets stats["full"]). The whole beat touches
+        only the node's own shard — never the control lock, never an
+        O(cluster) scan."""
         raylet_drained = False
-        with self._lock:
-            n = self._nodes.get(node_id)
-            alive = sum(1 for m in self._nodes.values() if m["alive"])
+        alive = self._alive_nodes()
+        # Warm-pool demand hint: this node's share of the autoscaler's
+        # pending-work forecast — launches expected but NOT yet
+        # registered (registration consumes the forecast). Deliberately
+        # excludes already-registered PENDING actors: those are consuming
+        # the pool right now, the raylet's local launch-rate EWMA already
+        # sees them, and counting them here double-inflated the target
+        # right as the storm peaked. Read lock-free BEFORE the shard lock
+        # (the tuple is swapped atomically; gcs.state must never be taken
+        # while a shard lock is held).
+        fc_n, fc_exp = self._demand_forecast
+        pool_hint = 0
+        if fc_n > 0 and time.monotonic() < fc_exp and alive > 0:
+            pool_hint = -(-fc_n // alive)  # ceil division
+        sh = self._node_shard(node_id)
+        with self._locked(sh):
+            n = sh.nodes.get(node_id)
             if n is None:
                 return {"ok": False, "nodes": alive}
-            # Warm-pool demand hint: this node's share of the
-            # autoscaler's pending-work forecast — launches expected but
-            # NOT yet registered (registration consumes the forecast).
-            # Deliberately excludes already-registered PENDING actors:
-            # those are consuming the pool right now, the raylet's local
-            # launch-rate EWMA already sees them, and counting them here
-            # double-inflated the target right as the storm peaked.
-            fc_n, fc_exp = self._demand_forecast
-            pool_hint = 0
-            if fc_n > 0 and time.monotonic() < fc_exp and alive > 0:
-                pool_hint = -(-fc_n // alive)  # ceil division
             # Verdict and update under ONE lock acquisition: judging here
             # and re-deriving inside _reject_stale_node left a window
             # where a concurrent re-registration flipped the record
             # between the two and a fenced-judged heartbeat returned ok
             # without having applied its update.
-            verdict = self._fence_verdict_locked(node_id, n, epoch)
+            verdict = self._fence_verdict_locked(sh, node_id, n, epoch)
             if verdict is None:
-                n["available"] = dict(available)
                 if stats:
-                    n["stats"] = dict(stats)
-                    if stats.get("draining") and not n.get("draining"):
+                    _hb.apply_heartbeat(n, available, dict(stats))
+                    merged = n.get("stats") or {}
+                    if merged.get("draining") and not n.get("draining"):
                         raylet_drained = True
                     # Clock-offset sampling on the heartbeat path: the
                     # raylet stamps its wall-clock send time; offset =
@@ -509,9 +728,11 @@ class GcsService(ChaosPartitionRpc):
                     # inter-host skews this corrects). The incident
                     # merger shifts that node's flight/span timestamps
                     # by this to restore cross-node causal order.
-                    wall = stats.get("wall_ts")
+                    wall = merged.get("wall_ts")
                     if isinstance(wall, (int, float)):
                         n["clock_offset_us"] = int((time.time() - wall) * 1e6)
+                elif available is not None:
+                    n["available"] = dict(available)
                 n["last_hb"] = time.monotonic()
         if verdict is not None:
             # A heartbeat from a dead-marked node used to flip it back
@@ -554,21 +775,23 @@ class GcsService(ChaosPartitionRpc):
         the `node_events` pubsub channel so gang supervisors (train,
         serve, cgraph drivers) can checkpoint/replace before the machine
         actually dies at the deadline."""
-        with self._lock:
-            n = self._nodes.get(node_id)
+        sh = self._node_shard(node_id)
+        with self._locked(sh):
+            n = sh.nodes.get(node_id)
             if n is None:
                 return False
             already = bool(n.get("draining"))
             n["draining"] = True
             n["drain_reason"] = reason
             n["drain_deadline"] = time.time() + max(0.0, deadline_s)
-            self._persist_delta("_nodes", node_id, n)
+            sh.wal_append("_nodes", node_id, n)
             sock = n["sock"] if n["alive"] else None
         if already:
             return True
         imet.NODES_DRAINED.inc()
         _frec_record("node.drain_notice", (node_id[:12], deadline_s, reason))
         self._announce_draining(node_id, deadline_s, reason)
+        self._publish_node_delta(node_id)
         # Flip the raylet into drain mode (best-effort: on a real
         # preemption the machine may already be unreachable — the pubsub
         # notice above is the part subscribers can rely on).
@@ -593,11 +816,14 @@ class GcsService(ChaosPartitionRpc):
         )
 
     def drain_node(self, node_id: str) -> bool:
-        with self._lock:
-            n = self._nodes.get(node_id)
+        sh = self._node_shard(node_id)
+        with self._locked(sh):
+            n = sh.nodes.get(node_id)
             if n:
+                if n["alive"]:
+                    sh.alive_count -= 1
                 n["alive"] = False
-                self._persist_delta("_nodes", node_id, n)
+                sh.wal_append("_nodes", node_id, n)
         self._on_node_death(node_id)
         return True
 
@@ -612,57 +838,123 @@ class GcsService(ChaosPartitionRpc):
             return "DRAINING" if n.get("draining") else "ALIVE"
         return "FENCED" if n.get("fenced") else "DEAD"
 
-    def list_nodes(self) -> List[dict]:
-        with self._lock:
-            return [
-                {"NodeID": nid, "Alive": n["alive"], "Resources": dict(n["resources"]),
-                 "Available": dict(n["available"]), "Labels": dict(n.get("labels") or {}),
-                 "Stats": dict(n.get("stats") or {}),
-                 "Draining": bool(n.get("draining")),
-                 "DrainReason": n.get("drain_reason"),
-                 "DrainDeadline": n.get("drain_deadline"),
-                 "Epoch": n.get("epoch"),
-                 "Fenced": bool(n.get("fenced")),
-                 "State": self._node_state(n),
-                 "sock": n["sock"], "store": n["store"]}
-                for nid, n in self._nodes.items()
-            ]
+    @classmethod
+    def _node_entry(cls, nid: str, n: dict) -> dict:
+        return {
+            "NodeID": nid, "Alive": n["alive"], "Resources": dict(n["resources"]),
+            "Available": dict(n["available"]), "Labels": dict(n.get("labels") or {}),
+            "Stats": dict(n.get("stats") or {}),
+            "Draining": bool(n.get("draining")),
+            "DrainReason": n.get("drain_reason"),
+            "DrainDeadline": n.get("drain_deadline"),
+            "Epoch": n.get("epoch"),
+            "Fenced": bool(n.get("fenced")),
+            "State": cls._node_state(n),
+            "sock": n["sock"], "store": n["store"],
+        }
+
+    # Full-dump cache freshness window and the cluster size at which it
+    # engages. Below the threshold every call reads fresh (tests and
+    # small clusters see exact state); above it, concurrent dump callers
+    # share one build per window instead of each walking 1000 records.
+    _VIEW_TTL_S = 0.25
+    _VIEW_MIN_NODES = 256
+
+    def _build_node_view(self, limit: Optional[int]) -> List[dict]:
+        out: List[dict] = []
+        for sh in self._shards:
+            with self._locked(sh):
+                for nid, n in sh.nodes.items():
+                    out.append(self._node_entry(nid, n))
+                    if limit is not None and len(out) >= limit:
+                        return out
+        return out
+
+    def list_nodes(self, limit: Optional[int] = None) -> List[dict]:
+        if limit is not None:
+            return self._build_node_view(max(0, int(limit)))
+        if self._node_count() < self._VIEW_MIN_NODES:
+            return self._build_node_view(None)
+        # Single-flight at scale: one builder per freshness window; the
+        # other dump callers (status, autoscaler, dashboard) wait on the
+        # view lock and reuse its result.
+        with self._view_lock:
+            ts, cached = self._view_cache
+            if time.monotonic() - ts < self._VIEW_TTL_S:
+                return cached
+            fresh = self._build_node_view(None)
+            self._view_cache = (time.monotonic(), fresh)
+            return fresh
+
+    def node_summary(self) -> dict:
+        """O(nodes) single-pass rollup for `ray-tpu status --summary`:
+        counts by membership state plus cluster resource totals — the
+        1000-node answer that doesn't ship 1000 full records."""
+        by_state: Dict[str, int] = {}
+        resources: Dict[str, float] = {}
+        available: Dict[str, float] = {}
+        draining = 0
+        total = 0
+        for sh in self._shards:
+            with self._locked(sh):
+                for n in sh.nodes.values():
+                    total += 1
+                    st = self._node_state(n)
+                    by_state[st] = by_state.get(st, 0) + 1
+                    if n.get("draining"):
+                        draining += 1
+                    if n["alive"]:
+                        for k, v in n["resources"].items():
+                            resources[k] = resources.get(k, 0.0) + v
+                        for k, v in n["available"].items():
+                            available[k] = available.get(k, 0.0) + v
+        return {
+            "total": total,
+            "alive": self._alive_nodes(),
+            "draining": draining,
+            "by_state": by_state,
+            "resources": resources,
+            "available": available,
+        }
 
     def list_actors(self, limit: int = 1000) -> List[dict]:
         """Actor table summary for the state API (reference:
         python/ray/util/state/api.py list_actors)."""
-        with self._lock:
-            out = [
-                {
-                    "actor_id": aid,
-                    "state": a["state"],
-                    "node_id": a.get("node_id"),
-                    "name": a.get("name"),
-                    "namespace": a.get("namespace"),
-                    "num_restarts": a.get("num_restarts", 0),
-                    "max_restarts": a.get("max_restarts", 0),
-                    "pg_id": a.get("pg_id"),
-                    "death_reason": a.get("death_reason", ""),
-                }
-                for aid, a in self._actors.items()
-            ]
+        out: List[dict] = []
+        for sh in self._shards:
+            with self._locked(sh):
+                out.extend(
+                    {
+                        "actor_id": aid,
+                        "state": a["state"],
+                        "node_id": a.get("node_id"),
+                        "name": a.get("name"),
+                        "namespace": a.get("namespace"),
+                        "num_restarts": a.get("num_restarts", 0),
+                        "max_restarts": a.get("max_restarts", 0),
+                        "pg_id": a.get("pg_id"),
+                        "death_reason": a.get("death_reason", ""),
+                    }
+                    for aid, a in sh.actors.items()
+                )
         return out[-limit:]
 
     def list_objects(self, limit: int = 1000) -> List[dict]:
         """Object directory summary (reference: list_objects in the state
         API; ours reports locations + borrow/pending-free status)."""
-        with self._lock:
-            out = []
-            for h, locs in list(self._objects.items())[-limit:]:
-                out.append(
-                    {
-                        "object_id": h,
-                        "locations": sorted(locs),
-                        "borrows": self._borrows.get(h, 0),
-                        "pending_free": h in self._deferred_free,
-                    }
-                )
-        return out
+        out = []
+        for sh in self._shards:
+            with self._locked(sh):
+                for h, locs in list(sh.objects.items())[-limit:]:
+                    out.append(
+                        {
+                            "object_id": h,
+                            "locations": sorted(locs),
+                            "borrows": sh.borrows.get(h, 0),
+                            "pending_free": h in sh.deferred_free,
+                        }
+                    )
+        return out[-limit:]
 
     def _merge_metric_records(
         self,
@@ -796,7 +1088,7 @@ class GcsService(ChaosPartitionRpc):
         GCS opts in — the raylet's task fast path stays uninstrumented at
         the RPC layer)."""
         imet.GCS_RPC_TOTAL.inc(method=method)
-        if method != "pubsub_poll":
+        if method not in ("pubsub_poll", "pubsub_poll2"):
             # Long-poll duration is the subscriber's wait, not GCS work —
             # it would drown the latency histogram.
             imet.GCS_RPC_LATENCY.observe(latency_ms, method=method)
@@ -808,49 +1100,57 @@ class GcsService(ChaosPartitionRpc):
             by_state: Dict[str, int] = {}
             for rec in self._tasks.values():
                 by_state[rec["state"]] = by_state.get(rec["state"], 0) + 1
-            actor_states: Dict[str, int] = {}
-            for a in self._actors.values():
-                actor_states[a["state"]] = actor_states.get(a["state"], 0) + 1
-            store = {"bytes_in_use": 0, "num_objects": 0, "num_spilled": 0}
-            for n in self._nodes.values():
-                if not n["alive"]:
-                    continue
-                s = n.get("stats") or {}
-                for k in store:
-                    store[k] += int(s.get(k, 0))
-            return {
-                "tasks": by_state,
-                "actors": actor_states,
-                "objects_indexed": len(self._objects),
-                "store": store,
-                "nodes_alive": sum(1 for n in self._nodes.values() if n["alive"]),
-                "placement_groups": len(self._pgs),
-            }
+            n_pgs = len(self._pgs)
+        actor_states: Dict[str, int] = {}
+        store = {"bytes_in_use": 0, "num_objects": 0, "num_spilled": 0}
+        objects_indexed = 0
+        for sh in self._shards:
+            with self._locked(sh):
+                for a in sh.actors.values():
+                    actor_states[a["state"]] = actor_states.get(a["state"], 0) + 1
+                objects_indexed += len(sh.objects)
+                for n in sh.nodes.values():
+                    if not n["alive"]:
+                        continue
+                    s = n.get("stats") or {}
+                    for k in store:
+                        store[k] += int(s.get(k, 0))
+        return {
+            "tasks": by_state,
+            "actors": actor_states,
+            "objects_indexed": objects_indexed,
+            "store": store,
+            "nodes_alive": self._alive_nodes(),
+            "placement_groups": n_pgs,
+        }
 
     def node_info(self, node_id: str) -> Optional[dict]:
-        with self._lock:
-            n = self._nodes.get(node_id)
+        sh = self._node_shard(node_id)
+        with self._locked(sh):
+            n = sh.nodes.get(node_id)
             return dict(n) if n else None
 
     def cluster_resources(self) -> Dict[str, float]:
-        with self._lock:
-            out: Dict[str, float] = {}
-            for n in self._nodes.values():
-                if not n["alive"]:
-                    continue
-                for k, v in n["resources"].items():
-                    out[k] = out.get(k, 0.0) + v
-            return out
+        out: Dict[str, float] = {}
+        for sh in self._shards:
+            with self._locked(sh):
+                for n in sh.nodes.values():
+                    if not n["alive"]:
+                        continue
+                    for k, v in n["resources"].items():
+                        out[k] = out.get(k, 0.0) + v
+        return out
 
     def available_resources(self) -> Dict[str, float]:
-        with self._lock:
-            out: Dict[str, float] = {}
-            for n in self._nodes.values():
-                if not n["alive"]:
-                    continue
-                for k, v in n["available"].items():
-                    out[k] = out.get(k, 0.0) + v
-            return out
+        out: Dict[str, float] = {}
+        for sh in self._shards:
+            with self._locked(sh):
+                for n in sh.nodes.values():
+                    if not n["alive"]:
+                        continue
+                    for k, v in n["available"].items():
+                        out[k] = out.get(k, 0.0) + v
+        return out
 
     # ------------------------------------------------- scheduling assist
     def pick_node(
@@ -867,30 +1167,43 @@ class GcsService(ChaosPartitionRpc):
         by a heartbeat, so a burst of submissions must not all land on the
         momentarily-least-utilized node)."""
         exclude = set(exclude or [])
-        with self._lock:
-            feasible = []
-            best = None
-            best_used = -1.0
-            for nid, n in sorted(self._nodes.items()):
-                if nid in exclude or not n["alive"] or n.get("draining"):
-                    # A draining node is leaving: placing new work there
-                    # would lose it at the preemption deadline.
-                    continue
-                avail = n["available"]
-                if all(avail.get(k, 0.0) >= v for k, v in resources.items()):
-                    entry = {"node_id": nid, "sock": n["sock"], "store": n["store"]}
-                    feasible.append(entry)
-                    total = sum(n["resources"].values()) or 1.0
-                    used = 1.0 - sum(avail.values()) / total
-                    if used > best_used:
-                        best_used = used
-                        best = entry
-            if not feasible:
-                return None
-            if mode == "spread":
+        candidates: List[Tuple[str, dict]] = []
+        for sh in self._shards:
+            with self._locked(sh):
+                for nid, n in sh.nodes.items():
+                    if nid in exclude or not n["alive"] or n.get("draining"):
+                        # A draining node is leaving: placing new work
+                        # there would lose it at the preemption deadline.
+                        continue
+                    avail = n["available"]
+                    if all(
+                        avail.get(k, 0.0) >= v for k, v in resources.items()
+                    ):
+                        candidates.append(
+                            (
+                                nid,
+                                {
+                                    "node_id": nid,
+                                    "sock": n["sock"],
+                                    "store": n["store"],
+                                    "_used": 1.0
+                                    - sum(avail.values())
+                                    / (sum(n["resources"].values()) or 1.0),
+                                },
+                            )
+                        )
+        if not candidates:
+            return None
+        candidates.sort(key=lambda c: c[0])  # stable order across shard layouts
+        feasible = [e for _, e in candidates]
+        best = max(feasible, key=lambda e: e["_used"])
+        if mode == "spread":
+            with self._lock:
                 self._spread_rr = getattr(self, "_spread_rr", -1) + 1
-                return feasible[self._spread_rr % len(feasible)]
-            return best
+                chosen = feasible[self._spread_rr % len(feasible)]
+        else:
+            chosen = best
+        return {k: v for k, v in chosen.items() if k != "_used"}
 
     def _health_loop(self):
         tick = 0
@@ -923,36 +1236,41 @@ class GcsService(ChaosPartitionRpc):
                 self._kick_stranded_restarts()
             dead = []
             lag_records: List[dict] = []
-            with self._lock:
-                for nid, n in self._nodes.items():
-                    if n["alive"] and time.monotonic() - n["last_hb"] > HEARTBEAT_TIMEOUT_S:
-                        n["alive"] = False
-                        dead.append(nid)
-                    elif n["alive"] and tick % 10 == 0 and self._history is not None:
-                        # Heartbeat lag gauge, once per second per alive
-                        # node: the signal the heartbeat_lag watchdog
-                        # rule (and `ray-tpu top`) watches. Fed through
-                        # the normal report path so the table, /metrics,
-                        # and history all agree.
-                        # Record shape tied to the declared instrument
-                        # (name/component/tag come from the catalog so a
-                        # rename cannot desynchronize them); hand-built
-                        # rather than set on the Gauge because this must
-                        # land SYNCHRONOUSLY — an in-process GcsService
-                        # has no flusher wired to itself.
-                        lag = imet.NODE_HEARTBEAT_LAG
-                        lag_records.append(
-                            {
-                                "name": lag.name,
-                                "kind": lag.kind,
-                                "value": time.monotonic() - n["last_hb"],
-                                "tags": {
-                                    "component": lag.component,
-                                    "node_id": "gcs",
-                                    lag.tag_keys[0]: nid[:12],
-                                },
-                            }
-                        )
+            sample_lag = tick % 10 == 0 and self._history is not None
+            for sh in self._shards:
+                with self._locked(sh):
+                    for nid, n in sh.nodes.items():
+                        if not n["alive"]:
+                            continue
+                        if time.monotonic() - n["last_hb"] > HEARTBEAT_TIMEOUT_S:
+                            n["alive"] = False
+                            sh.alive_count -= 1
+                            dead.append(nid)
+                        elif sample_lag:
+                            # Heartbeat lag gauge, once per second per alive
+                            # node: the signal the heartbeat_lag watchdog
+                            # rule (and `ray-tpu top`) watches. Fed through
+                            # the normal report path so the table, /metrics,
+                            # and history all agree.
+                            # Record shape tied to the declared instrument
+                            # (name/component/tag come from the catalog so a
+                            # rename cannot desynchronize them); hand-built
+                            # rather than set on the Gauge because this must
+                            # land SYNCHRONOUSLY — an in-process GcsService
+                            # has no flusher wired to itself.
+                            lag = imet.NODE_HEARTBEAT_LAG
+                            lag_records.append(
+                                {
+                                    "name": lag.name,
+                                    "kind": lag.kind,
+                                    "value": time.monotonic() - n["last_hb"],
+                                    "tags": {
+                                        "component": lag.component,
+                                        "node_id": "gcs",
+                                        lag.tag_keys[0]: nid[:12],
+                                    },
+                                }
+                            )
             if lag_records:
                 self.report_internal_metrics("gcs", lag_records)
             for nid in dead:
@@ -971,6 +1289,7 @@ class GcsService(ChaosPartitionRpc):
             {"event": "node_dead", "node_id": node_id, "ts": time.time()},
         )
         self._trigger("node.dead", {"node_id": node_id[:12]}, source="gcs")
+        self._publish_node_delta(node_id)
         gangs: List[str] = []
         with self._lock:
             for pg_id, pg in self._pgs.items():
@@ -986,18 +1305,15 @@ class GcsService(ChaosPartitionRpc):
                 target=lambda: [self._reschedule_gang(p) for p in gangs],
                 daemon=True,
             ).start()
-        restart_candidates: List[str] = []
+        dead_sock = self._node_sock(node_id, alive_only=False)
         with self._lock:
-            n = self._nodes.get(node_id)
-            if n is not None:
-                cli = self._raylet_clients.pop(n["sock"], None)
+            if dead_sock is not None:
+                cli = self._raylet_clients.pop(dead_sock, None)
                 if cli is not None:
                     try:
                         cli.close()
                     except Exception:  # lint: swallow-ok(closing a client to a dead node)
                         pass
-            for locs in self._objects.values():
-                locs.discard(node_id)
             # Tasks queued/running on the dead node can never complete there:
             # mark them failed so owners retry or reconstruct (reference:
             # task_manager node-death failure propagation).
@@ -1006,22 +1322,36 @@ class GcsService(ChaosPartitionRpc):
                     rec["state"] = "FAILED"
                     rec["reason"] = "node_died"
                     rec["ts"] = time.time()
-            for aid, a in self._actors.items():
-                # RESTARTING is included: a restart whose target node died
-                # between placement and actor_started would otherwise keep
-                # node_id pinned to the corpse — invisible to both the
-                # death sweep (old condition) and the stranded-actor retry
-                # (which only takes node-less records) — a permanent wedge.
-                if a.get("node_id") == node_id and a["state"] in (
-                    "ALIVE", "PENDING", "RESTARTING",
-                ):
-                    a["state"] = "RESTARTING" if self._can_restart(a) else "DEAD"
-                    a["node_id"] = None
-                    if a["state"] == "DEAD":
-                        a["death_reason"] = f"node {node_id[:8]} died"
-                        self._drop_name(aid)
-                    else:
-                        restart_candidates.append(aid)
+        restart_candidates: List[str] = []
+        name_drops: List[Tuple[str, dict]] = []
+        for sh in self._shards:
+            with self._locked(sh):
+                for locs in sh.objects.values():
+                    locs.discard(node_id)
+                for aid, a in sh.actors.items():
+                    # RESTARTING is included: a restart whose target node died
+                    # between placement and actor_started would otherwise keep
+                    # node_id pinned to the corpse — invisible to both the
+                    # death sweep (old condition) and the stranded-actor retry
+                    # (which only takes node-less records) — a permanent wedge.
+                    if a.get("node_id") == node_id and a["state"] in (
+                        "ALIVE", "PENDING", "RESTARTING",
+                    ):
+                        a["state"] = "RESTARTING" if self._can_restart(a) else "DEAD"
+                        a["node_id"] = None
+                        if a["state"] == "DEAD":
+                            a["death_reason"] = f"node {node_id[:8]} died"
+                            # Name release touches _named (control lock):
+                            # collected here, applied AFTER the shard lock
+                            # is released — gcs.state must never be taken
+                            # while a shard lock is held.
+                            name_drops.append((aid, a))
+                        else:
+                            restart_candidates.append(aid)
+        if name_drops:
+            with self._lock:
+                for aid, a in name_drops:
+                    self._drop_name(aid, a)
         if restart_candidates:
             # Node death must DRIVE restarts: with the node gone there is
             # no raylet left to report actor_died, so without this the
@@ -1040,20 +1370,19 @@ class GcsService(ChaosPartitionRpc):
         restart implementation behind both node death and raylet-reported
         actor_died. No capacity now -> stays RESTARTING and is retried
         when the next node registers (and on the health loop cadence)."""
+        sh = self._actor_shard(actor_id)
         with self._lock:
-            a = self._actors.get(actor_id)
-            if (
-                a is None
-                or a["state"] != "RESTARTING"
-                or a.get("node_id")
-                or actor_id in self._actor_restarting
-            ):
+            if actor_id in self._actor_restarting:
                 return
+            with self._locked(sh):
+                a = sh.actors.get(actor_id)
+                if a is None or a["state"] != "RESTARTING" or a.get("node_id"):
+                    return
+                resources = dict(a["resources"])
+                pg_id = a.get("pg_id")
+                bundle_index = a.get("bundle_index", -1)
+                strategy = a.get("strategy", "DEFAULT")
             self._actor_restarting.add(actor_id)  # CAS: one restarter at a time
-            resources = dict(a["resources"])
-            pg_id = a.get("pg_id")
-            bundle_index = a.get("bundle_index", -1)
-            strategy = a.get("strategy", "DEFAULT")
         try:
             if pg_id:
                 node = self.pick_bundle(pg_id, bundle_index)
@@ -1082,25 +1411,26 @@ class GcsService(ChaosPartitionRpc):
                     )
                 if terminal_reason is not None:
                     with self._lock:
-                        a = self._actors.get(actor_id)
-                        if (
-                            a is not None
-                            and a["state"] == "RESTARTING"
-                            and not a.get("node_id")
-                        ):
-                            a["state"] = "DEAD"
-                            a["death_reason"] = terminal_reason
-                            self._drop_name(actor_id)
-                            self._persist_delta("_actors", actor_id, a)
+                        with self._locked(sh):
+                            a = sh.actors.get(actor_id)
+                            if (
+                                a is not None
+                                and a["state"] == "RESTARTING"
+                                and not a.get("node_id")
+                            ):
+                                a["state"] = "DEAD"
+                                a["death_reason"] = terminal_reason
+                                self._drop_name(actor_id, a)
+                                sh.wal_append("_actors", actor_id, a)
                     return
                 return  # no capacity yet: retried on the next node_added
-            with self._lock:
-                a = self._actors.get(actor_id)
+            with self._locked(sh):
+                a = sh.actors.get(actor_id)
                 if a is None or a["state"] != "RESTARTING" or a.get("node_id"):
                     return  # raced a raylet-reported restart
                 a["node_id"] = node["node_id"]
                 spec_blob = a["spec_blob"]
-                self._persist_delta("_actors", actor_id, a)
+                sh.wal_append("_actors", actor_id, a)
             try:
                 self._raylet_call(
                     node["sock"], "create_actor", spec_blob, True,
@@ -1109,18 +1439,18 @@ class GcsService(ChaosPartitionRpc):
             except Exception as e:
                 _log.warning("restart of actor %s on %s failed (%r); will retry",
                              actor_id[:8], node["node_id"][:8], e)
-                with self._lock:
-                    a = self._actors.get(actor_id)
+                with self._locked(sh):
+                    a = sh.actors.get(actor_id)
                     if a is not None and a["state"] == "RESTARTING":
                         # Back to stranded; retried later. Persisted: a
                         # GCS restart restoring the record still pinned
                         # to the failed target would hide it from the
                         # stranded sweep forever.
                         a["node_id"] = None
-                        self._persist_delta("_actors", actor_id, a)
+                        sh.wal_append("_actors", actor_id, a)
                 return
-            with self._lock:
-                a = self._actors.get(actor_id)
+            with self._locked(sh):
+                a = sh.actors.get(actor_id)
                 if a is not None:
                     # Budget accounting AFTER the create landed: one
                     # logical restart = one increment. Charging each
@@ -1128,7 +1458,7 @@ class GcsService(ChaosPartitionRpc):
                     # retried on a 2 s cadence) would silently exhaust a
                     # finite max_restarts without ever restarting.
                     a["num_restarts"] += 1
-                    self._persist_delta("_actors", actor_id, a)
+                    sh.wal_append("_actors", actor_id, a)
             imet.ACTOR_RESTARTS.inc()
         finally:
             with self._lock:
@@ -1148,10 +1478,15 @@ class GcsService(ChaosPartitionRpc):
                 # tick — no need for a second concurrent thread (a mass
                 # worker crash would otherwise fan out one per death).
                 return
-            has_stranded = any(
-                a["state"] == "RESTARTING" and not a.get("node_id")
-                for a in self._actors.values()
-            )
+            has_stranded = False
+            for sh in self._shards:
+                with self._locked(sh):
+                    if any(
+                        a["state"] == "RESTARTING" and not a.get("node_id")
+                        for a in sh.actors.values()
+                    ):
+                        has_stranded = True
+                        break
             if not has_stranded:
                 return
             self._stranded_sweep_inflight = True
@@ -1164,12 +1499,14 @@ class GcsService(ChaosPartitionRpc):
         invoked when new capacity registers, mirroring the stranded-gang
         retry."""
         try:
-            with self._lock:
-                stranded = [
-                    aid
-                    for aid, a in self._actors.items()
-                    if a["state"] == "RESTARTING" and not a.get("node_id")
-                ]
+            stranded: List[str] = []
+            for sh in self._shards:
+                with self._locked(sh):
+                    stranded.extend(
+                        aid
+                        for aid, a in sh.actors.items()
+                        if a["state"] == "RESTARTING" and not a.get("node_id")
+                    )
             for aid in stranded:
                 self._restart_actor(aid)
         finally:
@@ -1182,8 +1519,10 @@ class GcsService(ChaosPartitionRpc):
         mr = a.get("max_restarts", 0)
         return mr == -1 or a.get("num_restarts", 0) < mr
 
-    def _drop_name(self, actor_id: str) -> None:
-        a = self._actors.get(actor_id, {})
+    def _drop_name(self, actor_id: str, a: dict) -> None:
+        """Releases a dead actor's name claim. Caller holds self._lock
+        (the name table's lock) and passes the actor record it already
+        read — this method must not reach into a shard."""
         key = (a.get("namespace") or "default", a.get("name") or "")
         if a.get("name") and self._named.get(key) == actor_id:
             del self._named[key]
@@ -1198,8 +1537,9 @@ class GcsService(ChaosPartitionRpc):
         aff = decode_node_affinity(strategy)
         if aff is not None:
             target_id, soft = aff
-            with self._lock:
-                n = self._nodes.get(target_id)
+            sh = self._node_shard(target_id)
+            with self._locked(sh):
+                n = sh.nodes.get(target_id)
                 if (
                     n is not None
                     and n["alive"]
@@ -1212,6 +1552,123 @@ class GcsService(ChaosPartitionRpc):
                 return None
             return self.pick_node(resources)
         return self.pick_node(resources, mode="spread" if strategy == "SPREAD" else "pack")
+
+    def _claim_name(
+        self, actor_id: str, name: Optional[str], namespace: Optional[str]
+    ) -> Optional[Tuple[str, str]]:
+        """Claims the actor name up front so two concurrent registrations
+        cannot both pass the uniqueness check while placement runs
+        (TOCTOU). Returns the claimed key (None for unnamed actors)."""
+        key = (namespace or "default", name) if name else None
+        if key is not None:
+            with self._lock:
+                if key in self._named:
+                    raise ActorNameTakenError(f"actor name {name!r} already taken")
+                self._named[key] = actor_id
+        return key
+
+    def _release_name_claim(
+        self, key: Optional[Tuple[str, str]], actor_id: str
+    ) -> None:
+        if key is None:
+            return
+        with self._lock:
+            if self._named.get(key) == actor_id:
+                del self._named[key]
+
+    def _consume_forecast(self, n: int) -> None:
+        # Each registration CONSUMES one unit of the autoscaler's
+        # pending-work forecast: the forecast predicts launches that
+        # haven't arrived yet, so once they do, the pools must stop
+        # holding capacity for them (an unconsumed forecast kept
+        # refilling — and CPU-starving — the node straight through
+        # the launch storm it predicted).
+        with self._lock:
+            fc_n, fc_exp = self._demand_forecast
+            if fc_n > 0:
+                self._demand_forecast = (max(0, fc_n - n), fc_exp)
+
+    def _place_actor(
+        self,
+        resources: dict,
+        pg_id: Optional[str],
+        bundle_index: int,
+        strategy: str,
+    ) -> dict:
+        """Pure placement for one actor (no table mutation): bundle pin,
+        strategy placement, or the total-capacity overflow fallback.
+        Raises typed errors on permanently-unplaceable requests."""
+        if pg_id:
+            node = self.pick_bundle(pg_id, bundle_index)
+            if node is None:
+                raise PlacementGroupError(
+                    f"placement group {pg_id[:8]} bundle {bundle_index} not available"
+                )
+            return node
+        node = self._place_with_strategy(resources, strategy)
+        if node is None and not _is_hard_affinity(strategy):
+            # Busy cluster: fall back to a node whose TOTAL capacity
+            # fits — the raylet queues the creation until resources
+            # free, matching the reference's PENDING_CREATION state
+            # (gcs_actor_scheduler queues actors; it never fails
+            # them for transient load). Round-robin over the
+            # feasible nodes so a burst of overflow actors spreads
+            # its queues instead of piling onto one node.
+            feasible: List[Tuple[str, dict]] = []
+            for sh in self._shards:
+                with self._locked(sh):
+                    feasible.extend(
+                        (nid, {"node_id": nid, "sock": n["sock"], "store": n["store"]})
+                        for nid, n in sh.nodes.items()
+                        if n["alive"]
+                        and not n.get("draining")
+                        and all(
+                            n["resources"].get(k, 0.0) >= v
+                            for k, v in resources.items()
+                        )
+                    )
+            if feasible:
+                feasible.sort(key=lambda f: f[0])
+                with self._lock:
+                    self._overflow_rr = getattr(self, "_overflow_rr", -1) + 1
+                    node = feasible[self._overflow_rr % len(feasible)][1]
+        if node is None:
+            if _is_hard_affinity(strategy):
+                raise SchedulingError(
+                    f"hard NodeAffinity to {strategy.split(':')[1][:12]} "
+                    f"cannot be satisfied for actor requiring {resources}"
+                )
+            raise SchedulingError(
+                f"no node can EVER host actor requiring {resources}"
+            )
+        return node
+
+    @staticmethod
+    def _actor_record(
+        spec_blob: bytes,
+        node: dict,
+        resources: dict,
+        max_restarts: int,
+        pg_id: Optional[str],
+        bundle_index: int,
+        strategy: str,
+        name: Optional[str],
+        namespace: Optional[str],
+    ) -> dict:
+        return {
+            "state": "PENDING",
+            "node_id": node["node_id"],
+            "spec_blob": spec_blob,
+            "resources": dict(resources),
+            "max_restarts": max_restarts,
+            "num_restarts": 0,
+            "pg_id": pg_id,
+            "bundle_index": node.get("bundle_index", bundle_index) if pg_id else -1,
+            "strategy": strategy,
+            "name": name,
+            "namespace": namespace or "default",
+            "death_reason": "",
+        }
 
     def register_actor(
         self,
@@ -1229,86 +1686,23 @@ class GcsService(ChaosPartitionRpc):
         raylet/driver forwards the creation there). Reference:
         gcs_actor_manager.h RegisterActor + gcs_actor_scheduler placement.
         Bundle-pinned actors go to their reserved bundle\'s node."""
-        key = (namespace or "default", name) if name else None
-        if key is not None:
-            # Claim the name up front so two concurrent registrations cannot
-            # both pass the uniqueness check while pick_node runs (TOCTOU).
-            with self._lock:
-                if key in self._named:
-                    raise ActorNameTakenError(f"actor name {name!r} already taken")
-                self._named[key] = actor_id
+        key = self._claim_name(actor_id, name, namespace)
         try:
-            if pg_id:
-                node = self.pick_bundle(pg_id, bundle_index)
-                if node is None:
-                    raise PlacementGroupError(
-                        f"placement group {pg_id[:8]} bundle {bundle_index} not available"
-                    )
-            else:
-                node = self._place_with_strategy(resources, strategy)
-                if node is None and not _is_hard_affinity(strategy):
-                    # Busy cluster: fall back to a node whose TOTAL capacity
-                    # fits — the raylet queues the creation until resources
-                    # free, matching the reference's PENDING_CREATION state
-                    # (gcs_actor_scheduler queues actors; it never fails
-                    # them for transient load). Round-robin over the
-                    # feasible nodes so a burst of overflow actors spreads
-                    # its queues instead of piling onto one node.
-                    with self._lock:
-                        feasible = [
-                            {"node_id": nid, "sock": n["sock"], "store": n["store"]}
-                            for nid, n in sorted(self._nodes.items())
-                            if n["alive"]
-                            and not n.get("draining")
-                            and all(
-                                n["resources"].get(k, 0.0) >= v
-                                for k, v in resources.items()
-                            )
-                        ]
-                        if feasible:
-                            self._overflow_rr = getattr(self, "_overflow_rr", -1) + 1
-                            node = feasible[self._overflow_rr % len(feasible)]
-                if node is None:
-                    if _is_hard_affinity(strategy):
-                        raise SchedulingError(
-                            f"hard NodeAffinity to {strategy.split(':')[1][:12]} "
-                            f"cannot be satisfied for actor requiring {resources}"
-                        )
-                    raise SchedulingError(
-                        f"no node can EVER host actor requiring {resources}"
-                    )
+            node = self._place_actor(resources, pg_id, bundle_index, strategy)
         except BaseException:
-            if key is not None:
-                with self._lock:
-                    if self._named.get(key) == actor_id:
-                        del self._named[key]
+            self._release_name_claim(key, actor_id)
             raise
-        with self._lock:
-            # Each registration CONSUMES one unit of the autoscaler's
-            # pending-work forecast: the forecast predicts launches that
-            # haven't arrived yet, so once they do, the pools must stop
-            # holding capacity for them (an unconsumed forecast kept
-            # refilling — and CPU-starving — the node straight through
-            # the launch storm it predicted).
-            fc_n, fc_exp = self._demand_forecast
-            if fc_n > 0:
-                self._demand_forecast = (fc_n - 1, fc_exp)
-            self._actors[actor_id] = {
-                "state": "PENDING",
-                "node_id": node["node_id"],
-                "spec_blob": spec_blob,
-                "resources": dict(resources),
-                "max_restarts": max_restarts,
-                "num_restarts": 0,
-                "pg_id": pg_id,
-                "bundle_index": node.get("bundle_index", bundle_index) if pg_id else -1,
-                "strategy": strategy,
-                "name": name,
-                "namespace": namespace or "default",
-                "death_reason": "",
-            }
-            self._persist_delta("_actors", actor_id, self._actors[actor_id])
-            if key is not None:
+        self._consume_forecast(1)
+        record = self._actor_record(
+            spec_blob, node, resources, max_restarts, pg_id, bundle_index,
+            strategy, name, namespace,
+        )
+        sh = self._actor_shard(actor_id)
+        with self._locked(sh):
+            sh.actors[actor_id] = record
+            sh.wal_append("_actors", actor_id, record)
+        if key is not None:
+            with self._lock:
                 self._persist_delta("_named", key, actor_id)
         return node
 
@@ -1318,32 +1712,64 @@ class GcsService(ChaosPartitionRpc):
         grouped per target raylet into `create_actor_batch` calls — the
         control plane serializes on O(batches), not O(actors), and the
         driver's old two-round-trip create (register_actor + raylet
-        create_actor) collapses to one. Per-spec failures return as the
-        exception OBJECT in that spec's slot (re-raised driver-side);
-        one bad spec cannot fail its batch-mates. Forward replays are
-        safe: the raylet's create path is idempotent (PR 14)."""
-        results: List[dict] = []
-        by_sock: Dict[str, List[Tuple[int, bytes, int]]] = {}
+        create_actor) collapses to one. The batch is the unit of
+        cross-shard routing: after per-spec name claims and placement,
+        the records are PARTITIONED BY ACTOR SHARD and committed under
+        per-shard locks — one lock acquisition and ONE group-committed
+        WAL flush per shard touched, never a global lock. Per-spec
+        failures return as the exception OBJECT in that spec's slot
+        (re-raised driver-side); one bad spec cannot fail its
+        batch-mates. Forward replays are safe: the raylet's create path
+        is idempotent (PR 14)."""
+        results: List[Optional[dict]] = [None] * len(specs)
+        placed: List[Tuple[int, dict, dict, Optional[Tuple[str, str]]]] = []
         for i, s in enumerate(specs):
+            key = None
             try:
-                node = self.register_actor(
-                    s["actor_id"],
-                    s["spec_blob"],
+                key = self._claim_name(s["actor_id"], s.get("name"), s.get("namespace"))
+                node = self._place_actor(
                     s.get("resources") or {},
-                    s.get("max_restarts", 0),
-                    s.get("name"),
-                    s.get("namespace"),
                     s.get("pg_id"),
                     s.get("bundle_index", -1),
                     s.get("strategy", "DEFAULT"),
                 )
             except Exception as e:  # noqa: BLE001
-                results.append({"error": e})
+                self._release_name_claim(key, s["actor_id"])
+                results[i] = {"error": e}
                 continue
+            placed.append((i, s, node, key))
+        if placed:
+            self._consume_forecast(len(placed))
+        by_shard: Dict[int, List[Tuple[int, dict, dict, Optional[Tuple[str, str]]]]] = {}
+        for entry in placed:
+            by_shard.setdefault(
+                _gsh.shard_index(entry[1]["actor_id"], self._nshards), []
+            ).append(entry)
+        for idx in sorted(by_shard):
+            sh = self._shards[idx]
+            wal: List[Tuple[str, Any, Any]] = []
+            with self._locked(sh):
+                for _, s, node, _ in by_shard[idx]:
+                    rec = self._actor_record(
+                        s["spec_blob"], node, s.get("resources") or {},
+                        s.get("max_restarts", 0), s.get("pg_id"),
+                        s.get("bundle_index", -1), s.get("strategy", "DEFAULT"),
+                        s.get("name"), s.get("namespace"),
+                    )
+                    sh.actors[s["actor_id"]] = rec
+                    wal.append(("_actors", s["actor_id"], rec))
+                sh.wal_append_many(wal)
+        named = [(key, s["actor_id"]) for _, s, _, key in placed if key is not None]
+        if named:
+            with self._lock:
+                for key, aid in named:
+                    self._persist_delta("_named", key, aid)
+        by_sock: Dict[str, List[Tuple[int, bytes, int]]] = {}
+        for i, s, node, _ in placed:
             bi = node.get("bundle_index", -1)
-            results.append(
-                {"node_id": node["node_id"], "sock": node["sock"], "bundle_index": bi}
-            )
+            results[i] = {
+                "node_id": node["node_id"], "sock": node["sock"], "bundle_index": bi
+            }
             by_sock.setdefault(node["sock"], []).append((i, s["spec_blob"], bi))
         for sock, items in by_sock.items():
             try:
@@ -1359,17 +1785,19 @@ class GcsService(ChaosPartitionRpc):
                 _log.warning(
                     "create_actor_batch forward to %s failed: %r", sock, e
                 )
-                with self._lock:
-                    for i, _, _ in items:
-                        aid = specs[i]["actor_id"]
-                        a = self._actors.get(aid)
-                        if a is not None and a["state"] == "PENDING":
-                            a["state"] = "DEAD"
-                            a["death_reason"] = f"creation forward failed: {e!r}"
-                            a["node_id"] = None
-                            self._drop_name(aid)
-                            self._persist_delta("_actors", aid, a)
-                        results[i] = {"error": e}
+                for i, _, _ in items:
+                    aid = specs[i]["actor_id"]
+                    sh = self._actor_shard(aid)
+                    with self._lock:
+                        with self._locked(sh):
+                            a = sh.actors.get(aid)
+                            if a is not None and a["state"] == "PENDING":
+                                a["state"] = "DEAD"
+                                a["death_reason"] = f"creation forward failed: {e!r}"
+                                a["node_id"] = None
+                                self._drop_name(aid, a)
+                                sh.wal_append("_actors", aid, a)
+                    results[i] = {"error": e}
         return results
 
     def actor_started(
@@ -1379,8 +1807,9 @@ class GcsService(ChaosPartitionRpc):
         # already rescheduled elsewhere would repoint the record at the
         # duplicate instance.
         self._reject_stale_node(node_id, epoch, "actor_started")
-        with self._lock:
-            a = self._actors.get(actor_id)
+        sh = self._actor_shard(actor_id)
+        with self._locked(sh):
+            a = sh.actors.get(actor_id)
             if a:
                 if a["state"] == "DEAD" or a.get("node_id") not in (None, node_id):
                     # The record is terminally dead, or pinned to another
@@ -1392,7 +1821,7 @@ class GcsService(ChaosPartitionRpc):
                     return False
                 a["state"] = "ALIVE"
                 a["node_id"] = node_id
-                self._persist_delta("_actors", actor_id, a)
+                sh.wal_append("_actors", actor_id, a)
         return True
 
     def actor_started_batch(
@@ -1405,19 +1834,29 @@ class GcsService(ChaosPartitionRpc):
         is a duplicate to kill locally."""
         self._reject_stale_node(node_id, epoch, "actor_started_batch")
         out: Dict[str, bool] = {}
-        with self._lock:
-            for actor_id in actor_ids:
-                a = self._actors.get(actor_id)
-                if a and (
-                    a["state"] == "DEAD" or a.get("node_id") not in (None, node_id)
-                ):
-                    out[actor_id] = False
-                    continue
-                if a:
-                    a["state"] = "ALIVE"
-                    a["node_id"] = node_id
-                    self._persist_delta("_actors", actor_id, a)
-                out[actor_id] = True
+        by_shard: Dict[int, List[str]] = {}
+        for actor_id in actor_ids:
+            by_shard.setdefault(
+                _gsh.shard_index(actor_id, self._nshards), []
+            ).append(actor_id)
+        for idx in sorted(by_shard):
+            sh = self._shards[idx]
+            wal: List[Tuple[str, Any, Any]] = []
+            with self._locked(sh):
+                for actor_id in by_shard[idx]:
+                    a = sh.actors.get(actor_id)
+                    if a and (
+                        a["state"] == "DEAD" or a.get("node_id") not in (None, node_id)
+                    ):
+                        out[actor_id] = False
+                        continue
+                    if a:
+                        a["state"] = "ALIVE"
+                        a["node_id"] = node_id
+                        wal.append(("_actors", actor_id, a))
+                    out[actor_id] = True
+                if wal:
+                    sh.wal_append_many(wal)
         return out
 
     def actor_died(
@@ -1439,8 +1878,11 @@ class GcsService(ChaosPartitionRpc):
         every other mutation path."""
         if node_id is not None:
             self._reject_stale_node(node_id, epoch, "actor_died")
-        with self._lock:
-            a = self._actors.get(actor_id)
+        sh = self._actor_shard(actor_id)
+        # Control lock first (name drop needs it), THEN the actor's shard
+        # — the one legal nesting order.
+        with self._lock, self._locked(sh):
+            a = sh.actors.get(actor_id)
             if a is None:
                 return {"restart": False}
             if node_id is not None and a.get("node_id") not in (None, node_id):
@@ -1452,8 +1894,8 @@ class GcsService(ChaosPartitionRpc):
                 a["state"] = "DEAD"
                 a["death_reason"] = reason
                 a["node_id"] = None
-                self._drop_name(actor_id)
-                self._persist_delta("_actors", actor_id, a)
+                self._drop_name(actor_id, a)
+                sh.wal_append("_actors", actor_id, a)
                 return {"restart": False}
             # Flip to RESTARTING (unpinned) and hand off to the single
             # place-pin-create-charge implementation (_restart_actor) —
@@ -1467,19 +1909,22 @@ class GcsService(ChaosPartitionRpc):
             # name dropped so callers get a failure signal, not a wedge.
             a["state"] = "RESTARTING"
             a["node_id"] = None
-            self._persist_delta("_actors", actor_id, a)
+            sh.wal_append("_actors", actor_id, a)
         self._kick_stranded_restarts()
         return {"restart": True}
 
     def get_actor(self, actor_id: str) -> Optional[dict]:
-        with self._lock:
-            a = self._actors.get(actor_id)
+        sh = self._actor_shard(actor_id)
+        with self._locked(sh):
+            a = sh.actors.get(actor_id)
             if a is None:
                 return None
             out = {k: v for k, v in a.items() if k != "spec_blob"}
-            node = self._nodes.get(a["node_id"]) if a["node_id"] else None
-            out["sock"] = node["sock"] if node else None
-            return out
+            node_id = a["node_id"]
+        # Sock resolve on the NODE's shard happens after the actor shard
+        # is released — cross-shard reads are sequential, never nested.
+        out["sock"] = self._node_sock(node_id, alive_only=False) if node_id else None
+        return out
 
     def lookup_named_actor(self, name: str, namespace: Optional[str]) -> Optional[str]:
         with self._lock:
@@ -1487,44 +1932,59 @@ class GcsService(ChaosPartitionRpc):
 
     # ------------------------------------------------------------ objects
     def add_object_location(self, oid_hex: str, node_id: str) -> bool:
-        with self._lock:
-            self._objects.setdefault(oid_hex, set()).add(node_id)
+        sh = self._object_shard(oid_hex)
+        with self._locked(sh):
+            sh.objects.setdefault(oid_hex, set()).add(node_id)
         return True
 
     def remove_object_location(
         self, oid_hex: str, node_id: str, epoch: Optional[int] = None
     ) -> bool:
         self._reject_stale_node(node_id, epoch, "remove_object_location")
-        with self._lock:
-            locs = self._objects.get(oid_hex)
+        sh = self._object_shard(oid_hex)
+        with self._locked(sh):
+            locs = sh.objects.get(oid_hex)
             if locs is not None:
                 locs.discard(node_id)
                 if not locs:
-                    del self._objects[oid_hex]
+                    del sh.objects[oid_hex]
         return True
 
     def get_object_locations(self, oid_hex: str) -> List[dict]:
-        with self._lock:
-            locs = self._objects.get(oid_hex, set())
-            return [
-                {"node_id": nid, "sock": self._nodes[nid]["sock"], "store": self._nodes[nid]["store"]}
-                for nid in locs
-                if nid in self._nodes and self._nodes[nid]["alive"]
-            ]
+        sh = self._object_shard(oid_hex)
+        with self._locked(sh):
+            locs = list(sh.objects.get(oid_hex, ()))
+        view = self._nodes_view_for(locs)
+        return [
+            {"node_id": nid, "sock": view[nid]["sock"], "store": view[nid]["store"]}
+            for nid in locs
+            if nid in view and view[nid]["alive"]
+        ]
 
     def get_object_locations_batch(self, oid_hexes: List[str]) -> Dict[str, List[dict]]:
         """One round trip for a raylet's whole wait set."""
-        out: Dict[str, List[dict]] = {}
-        with self._lock:
-            for h in oid_hexes:
-                locs = self._objects.get(h)
-                if locs:
-                    out[h] = [
-                        {"node_id": nid, "sock": self._nodes[nid]["sock"]}
-                        for nid in locs
-                        if nid in self._nodes and self._nodes[nid]["alive"]
-                    ]
-        return out
+        found: Dict[str, List[str]] = {}
+        by_shard: Dict[int, List[str]] = {}
+        for h in oid_hexes:
+            by_shard.setdefault(_gsh.shard_index(h, self._nshards), []).append(h)
+        for idx in sorted(by_shard):
+            sh = self._shards[idx]
+            with self._locked(sh):
+                for h in by_shard[idx]:
+                    locs = sh.objects.get(h)
+                    if locs:
+                        found[h] = list(locs)
+        view = self._nodes_view_for(
+            sorted({nid for locs in found.values() for nid in locs})
+        )
+        return {
+            h: [
+                {"node_id": nid, "sock": view[nid]["sock"]}
+                for nid in locs
+                if nid in view and view[nid]["alive"]
+            ]
+            for h, locs in found.items()
+        }
 
     def free_objects(self, oid_hexes: List[str]) -> bool:
         """The owner dropped its last reference. The free is executed after
@@ -1544,28 +2004,58 @@ class GcsService(ChaosPartitionRpc):
         return True
 
     def _process_frees(self, grace: float = 0.1) -> None:
-        by_node: Dict[str, List[str]] = {}
         now = time.monotonic()
         with self._lock:
             ready = [b for ts, b in self._free_queue if now - ts >= grace]
             self._free_queue = [e for e in self._free_queue if now - e[0] < grace]
-            for batch in ready:
-                for h in batch:
-                    if self._borrows.get(h, 0) > 0:
-                        self._deferred_free.add(h)
+        if not ready:
+            return
+        by_shard: Dict[int, List[str]] = {}
+        for batch in ready:
+            for h in batch:
+                by_shard.setdefault(_gsh.shard_index(h, self._nshards), []).append(h)
+        freed: List[Tuple[str, List[str]]] = []
+        for idx in sorted(by_shard):
+            sh = self._shards[idx]
+            with self._locked(sh):
+                for h in by_shard[idx]:
+                    if sh.borrows.get(h, 0) > 0:
+                        sh.deferred_free.add(h)
                     else:
-                        self._release_locked(h, by_node)
-        self._delete_on_nodes(by_node)
+                        self._release_locked(sh, h, freed)
+        self._delete_on_nodes(self._socks_for_frees(freed))
 
-    def _release_locked(self, h: str, by_node: Dict[str, List[str]]) -> None:
-        """Tombstones h and collects its copies for deletion (lock held)."""
-        self._freed[h] = True
-        while len(self._freed) > 200_000:
-            self._freed.popitem(last=False)
-        for nid in self._objects.pop(h, ()):  # type: ignore[arg-type]
-            n = self._nodes.get(nid)
-            if n is not None and n["alive"]:
-                by_node.setdefault(n["sock"], []).append(h)
+    def _release_locked(
+        self, sh: _gsh.GcsShard, h: str, freed: List[Tuple[str, List[str]]]
+    ) -> None:
+        """Tombstones h and collects (h, locations) for deletion — the
+        owning shard's lock is held; sock resolution (a NODE-shard read)
+        happens after it is released, never nested under it."""
+        sh.freed[h] = True
+        cap = max(1024, 200_000 // self._nshards)
+        while len(sh.freed) > cap:
+            sh.freed.popitem(last=False)
+        locs = sh.objects.pop(h, None)
+        if locs:
+            freed.append((h, list(locs)))
+
+    def _socks_for_frees(
+        self, freed: List[Tuple[str, List[str]]]
+    ) -> Dict[str, List[str]]:
+        """(object, locations) pairs -> {sock: [objects]} for the delete
+        fan-out, keeping only currently-alive copies."""
+        if not freed:
+            return {}
+        view = self._nodes_view_for(
+            sorted({nid for _, locs in freed for nid in locs})
+        )
+        by_node: Dict[str, List[str]] = {}
+        for h, locs in freed:
+            for nid in locs:
+                v = view.get(nid)
+                if v is not None and v["alive"]:
+                    by_node.setdefault(v["sock"], []).append(h)
+        return by_node
 
     def _delete_on_nodes(self, by_node: Dict[str, List[str]]) -> None:
         for sock, hs in by_node.items():
@@ -1576,18 +2066,25 @@ class GcsService(ChaosPartitionRpc):
 
     def update_borrows(self, deltas: Dict[str, int]) -> bool:
         """Batched borrow-count adjustments from non-owner processes."""
-        by_node: Dict[str, List[str]] = {}
-        with self._lock:
-            for h, d in deltas.items():
-                c = self._borrows.get(h, 0) + d
-                if c > 0:
-                    self._borrows[h] = c
-                    continue
-                self._borrows.pop(h, None)
-                if h in self._deferred_free:
-                    self._deferred_free.discard(h)
-                    self._release_locked(h, by_node)
-        self._delete_on_nodes(by_node)
+        by_shard: Dict[int, List[Tuple[str, int]]] = {}
+        for h, d in deltas.items():
+            by_shard.setdefault(
+                _gsh.shard_index(h, self._nshards), []
+            ).append((h, d))
+        freed: List[Tuple[str, List[str]]] = []
+        for idx in sorted(by_shard):
+            sh = self._shards[idx]
+            with self._locked(sh):
+                for h, d in by_shard[idx]:
+                    c = sh.borrows.get(h, 0) + d
+                    if c > 0:
+                        sh.borrows[h] = c
+                        continue
+                    sh.borrows.pop(h, None)
+                    if h in sh.deferred_free:
+                        sh.deferred_free.discard(h)
+                        self._release_locked(sh, h, freed)
+        self._delete_on_nodes(self._socks_for_frees(freed))
         return True
 
     # -------------------------------------------------------------- tasks
@@ -1606,18 +2103,22 @@ class GcsService(ChaosPartitionRpc):
         locations)."""
         self._reject_stale_node(node_id, epoch, "node_sync")
         stale: List[str] = []
-        node_sock = None
+        by_shard: Dict[int, List[str]] = {}
+        for h in sealed:
+            by_shard.setdefault(_gsh.shard_index(h, self._nshards), []).append(h)
+        for idx in sorted(by_shard):
+            sh = self._shards[idx]
+            with self._locked(sh):
+                for h in by_shard[idx]:
+                    if h in sh.freed:
+                        # The owner freed this object before it sealed
+                        # (fire-and-forget task): delete the late copy
+                        # instead of indexing it.
+                        stale.append(h)
+                        continue
+                    sh.objects.setdefault(h, set()).add(node_id)
+        node_sock = self._node_sock(node_id) if stale else None
         with self._lock:
-            for h in sealed:
-                if h in self._freed:
-                    # The owner freed this object before it sealed (fire-and-
-                    # forget task): delete the late copy instead of indexing it.
-                    stale.append(h)
-                    continue
-                self._objects.setdefault(h, set()).add(node_id)
-            if stale:
-                n = self._nodes.get(node_id)
-                node_sock = n["sock"] if n and n["alive"] else None
             for evt in events:
                 tid = evt["task_id"]
                 rec = self._tasks.get(tid)
@@ -1742,6 +2243,105 @@ class GcsService(ChaosPartitionRpc):
                     return []
                 self._pubsub_cv.wait(timeout=min(remaining, 1.0))
 
+    def pubsub_poll2(
+        self, channel: str, after_seq: int = 0, timeout: float = 10.0
+    ) -> dict:
+        """Gap-aware delta poll: `{"entries": [(seq, msg), ...], "gap": bool}`.
+        `gap=True` means the subscriber's cursor fell behind the retention
+        ring — entries after its cursor were already trimmed, so an
+        incremental apply would silently miss deltas; the subscriber must
+        resync from a snapshot (`node_table_snapshot` for the node_table
+        channel) and resume from the seq the snapshot reports. A gap
+        returns IMMEDIATELY without long-polling: the caller is about to
+        do a full resync, and making it wait for fresh deltas first is
+        pure added lag. `pubsub_poll` keeps the old contract (silent
+        trim) for existing subscribers."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        gap = False
+        out: List[Tuple[int, Any]] = []
+        with self._pubsub_cv:
+            while True:
+                log = self._pubsub.get(channel, [])
+                if after_seq > 0 and log and log[0][0] > after_seq + 1:
+                    gap = True
+                    break
+                out = [(s, m) for s, m in log if s > after_seq]
+                if out:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._pubsub_cv.wait(timeout=min(remaining, 1.0))
+        if gap:
+            imet.GCS_PUBSUB_RESYNCS.inc(channel=channel)
+        elif out:
+            imet.GCS_PUBSUB_DELTAS.inc(len(out), channel=channel)
+        return {"entries": out, "gap": gap}
+
+    # ------------------------------------------------- node-table deltas
+    # The `node_table` channel replaces "poll list_nodes() every few
+    # seconds" for membership-tracking subscribers: each membership or
+    # lifecycle-state change publishes ONE slim per-node diff, and
+    # subscribers mirror the table locally by applying diffs in seq
+    # order. Deliberately EXCLUDED from the diff: `available` and
+    # `stats`, which change on every heartbeat — publishing those would
+    # turn the delta stream back into the full-snapshot firehose it
+    # replaces. Subscribers that need resource freshness read it from
+    # the snapshot they resync from, or query list_nodes directly.
+
+    @staticmethod
+    def _slim_node(nid: str, n: dict, epoch: int) -> dict:
+        return {
+            "op": "upsert",
+            "NodeID": nid,
+            "Alive": bool(n["alive"]),
+            "Draining": bool(n.get("draining")),
+            "Fenced": bool(n.get("fenced")),
+            "Epoch": epoch,
+            "State": "DEAD" if not n["alive"] else (
+                "DRAINING" if n.get("draining") else "ALIVE"
+            ),
+            "Labels": dict(n.get("labels") or {}),
+            "Resources": dict(n["resources"]),
+            "sock": n["sock"],
+            "store": n["store"],
+        }
+
+    def _publish_node_delta(self, node_id: str) -> None:
+        """Publishes the node's current slim row to `node_table`. Called
+        AFTER the mutation's shard lock is released (pubsub takes its own
+        condition lock; holding a shard lock across it would nest shard ->
+        pubsub under the fan-in's hottest locks)."""
+        sh = self._node_shard(node_id)
+        with self._locked(sh):
+            n = sh.nodes.get(node_id)
+            if n is None:
+                return
+            row = self._slim_node(node_id, n, sh.node_epochs.get(node_id, 0))
+        try:
+            self.pubsub_publish("node_table", row)
+        except Exception as e:  # lint: swallow-ok(subscribers resync from snapshot on gap)
+            _log.warning("node_table publish for %s failed: %r", node_id[:12], e)
+
+    def node_table_snapshot(self) -> dict:
+        """Resync point for node_table subscribers that fell behind the
+        retention ring: the full slim table plus the channel seq to
+        resume delta-polling from. The seq is captured BEFORE the table
+        is read — a delta published mid-build is then re-delivered and
+        re-applied (upserts are idempotent), never lost."""
+        with self._pubsub_cv:
+            log = self._pubsub.get("node_table", [])
+            seq = log[-1][0] if log else 0
+        nodes: List[dict] = []
+        for sh in self._shards:
+            with self._locked(sh):
+                nodes.extend(
+                    self._slim_node(nid, n, sh.node_epochs.get(nid, 0))
+                    for nid, n in sh.nodes.items()
+                )
+        imet.GCS_PUBSUB_RESYNCS.inc(channel="node_table.snapshot")
+        return {"seq": seq, "nodes": nodes}
+
     # ------------------------------------------------------ error reports
     # Cluster error table (reference: the error pubsub surfacing uncaught
     # worker exceptions at the driver, _private/utils.py publish_error_to
@@ -1781,12 +2381,14 @@ class GcsService(ChaosPartitionRpc):
         if strategy == "SLICE_GANG":
             return self._plan_slice_gang(bundles, banned)
         placements: List[str] = []
-        with self._lock:
-            avail = {
-                nid: dict(n["available"])
-                for nid, n in self._nodes.items()
-                if n["alive"] and nid not in banned and not n.get("draining")
-            }
+        avail: Dict[str, dict] = {}
+        for sh in self._shards:
+            with self._locked(sh):
+                avail.update(
+                    (nid, dict(n["available"]))
+                    for nid, n in sh.nodes.items()
+                    if n["alive"] and nid not in banned and not n.get("draining")
+                )
         order = sorted(avail, key=lambda nid: -sum(avail[nid].values()))
 
         def fits(nid, b):
@@ -1832,16 +2434,17 @@ class GcsService(ChaosPartitionRpc):
         the TPU-{pod}-head idiom at _private/accelerators/tpu.py:334-397 and
         bundle_scheduling_policy.h:82-106, redesigned as a first-class
         atomic policy over registered TpuSliceSpecs)."""
-        with self._lock:
-            slices: Dict[str, List[Tuple[int, str, dict]]] = {}
-            for nid, n in self._nodes.items():
-                if not n["alive"] or nid in banned or n.get("draining"):
-                    continue
-                sl = (n.get("labels") or {}).get("slice_name")
-                if not sl:
-                    continue
-                widx = int((n.get("labels") or {}).get("worker_index", 0))
-                slices.setdefault(sl, []).append((widx, nid, dict(n["available"])))
+        slices: Dict[str, List[Tuple[int, str, dict]]] = {}
+        for sh in self._shards:
+            with self._locked(sh):
+                for nid, n in sh.nodes.items():
+                    if not n["alive"] or nid in banned or n.get("draining"):
+                        continue
+                    sl = (n.get("labels") or {}).get("slice_name")
+                    if not sl:
+                        continue
+                    widx = int((n.get("labels") or {}).get("worker_index", 0))
+                    slices.setdefault(sl, []).append((widx, nid, dict(n["available"])))
         # Smallest slice that fits first: don't fragment big slices.
         for sl in sorted(slices, key=lambda s: (len(slices[s]), s)):
             hosts = sorted(slices[sl])
@@ -1878,9 +2481,7 @@ class GcsService(ChaosPartitionRpc):
             placements = list(pg["placements"])
             bundles = pg["bundles"]
         for i, nid in enumerate(placements):
-            with self._lock:
-                n = self._nodes.get(nid)
-                sock = n["sock"] if n and n["alive"] else None
+            sock = self._node_sock(nid)
             if sock:
                 try:
                     self._raylet_call(sock, "release_bundle", pg_id, i)
@@ -1910,9 +2511,7 @@ class GcsService(ChaosPartitionRpc):
             reserved: List[Tuple[str, int]] = []
             failed_node = None
             for i, (nid, bundle) in enumerate(zip(placements, bundles)):
-                with self._lock:
-                    node = self._nodes.get(nid)
-                    sock = node["sock"] if node and node["alive"] else None
+                sock = self._node_sock(nid)
                 ok = False
                 if sock is not None:
                     try:
@@ -1929,14 +2528,13 @@ class GcsService(ChaosPartitionRpc):
                 # heartbeat that already reflects the lease would otherwise
                 # be debited twice.
                 for nid in set(placements):
-                    with self._lock:
-                        node = self._nodes.get(nid)
-                        sock = node["sock"] if node else None
+                    sock = self._node_sock(nid, alive_only=False)
                     if sock:
                         try:
                             _, avail = self._raylet_call(sock, "node_resources")
-                            with self._lock:
-                                node = self._nodes.get(nid)
+                            nsh = self._node_shard(nid)
+                            with self._locked(nsh):
+                                node = nsh.nodes.get(nid)
                                 if node:
                                     node["available"] = dict(avail)
                         except Exception:  # lint: swallow-ok(advisory resource-view refresh)
@@ -1956,9 +2554,7 @@ class GcsService(ChaosPartitionRpc):
                     # remove_placement_group raced the (re)creation: undo
                     # the fresh leases instead of leaking them ownerlessly.
                     for nid, i in reserved:
-                        with self._lock:
-                            node = self._nodes.get(nid)
-                            sock = node["sock"] if node else None
+                        sock = self._node_sock(nid, alive_only=False)
                         if sock:
                             try:
                                 self._raylet_call(sock, "release_bundle", pg_id, i)
@@ -1968,9 +2564,7 @@ class GcsService(ChaosPartitionRpc):
                 return {"placements": placements}
             # Roll back partial gang, ban the refusing node, replan.
             for nid, i in reserved:
-                with self._lock:
-                    node = self._nodes.get(nid)
-                    sock = node["sock"] if node else None
+                sock = self._node_sock(nid, alive_only=False)
                 if sock:
                     try:
                         self._raylet_call(sock, "release_bundle", pg_id, i)
@@ -2009,8 +2603,9 @@ class GcsService(ChaosPartitionRpc):
                 self._removed_pgs.popitem(last=False)
         if pg:
             for i, (nid, bundle) in enumerate(zip(pg["placements"], pg["bundles"])):
-                with self._lock:
-                    n = self._nodes.get(nid)
+                nsh = self._node_shard(nid)
+                with self._locked(nsh):
+                    n = nsh.nodes.get(nid)
                     sock = n["sock"] if n and n["alive"] else None
                     if n:
                         for k, v in bundle.items():
@@ -2039,15 +2634,19 @@ class GcsService(ChaosPartitionRpc):
             if bundle_index >= len(pg["placements"]):
                 return None
             nid = pg["placements"][bundle_index]
-            n = self._nodes.get(nid)
-            if n is None or not n["alive"]:
-                return None
-            return {
-                "node_id": nid,
-                "sock": n["sock"],
-                "store": n["store"],
-                "bundle_index": bundle_index,
-            }
+            # Control -> node-shard nesting (the legal order): the rr
+            # cursor above must stay consistent with the liveness check.
+            nsh = self._node_shard(nid)
+            with self._locked(nsh):
+                n = nsh.nodes.get(nid)
+                if n is None or not n["alive"]:
+                    return None
+                return {
+                    "node_id": nid,
+                    "sock": n["sock"],
+                    "store": n["store"],
+                    "bundle_index": bundle_index,
+                }
 
     def register_pending_placement_group(
         self, pg_id: str, bundles: List[dict], strategy: str
@@ -2232,12 +2831,14 @@ class GcsService(ChaosPartitionRpc):
                 return
             inc["state"] = "harvesting"
         try:
-            with self._lock:
-                nodes = [
-                    (nid, n["sock"], int(n.get("clock_offset_us") or 0))
-                    for nid, n in self._nodes.items()
-                    if n["alive"]
-                ]
+            nodes = []
+            for sh in self._shards:
+                with self._locked(sh):
+                    nodes.extend(
+                        (nid, n["sock"], int(n.get("clock_offset_us") or 0))
+                        for nid, n in sh.nodes.items()
+                        if n["alive"]
+                    )
             pids: Dict[str, dict] = {
                 str(os.getpid()): {"node": "gcs", "offset_us": 0}
             }

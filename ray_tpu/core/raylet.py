@@ -39,6 +39,7 @@ from ..observability.flight_recorder import record as _flight_record
 from ..observability.logs import get_logger as _get_logger
 from ..utils import internal_metrics as imet
 from ..utils.config import CONFIG
+from .heartbeat import HeartbeatCodec
 from .ids import ObjectID
 from .object_transport import StoredError
 from .placement_group import decode_node_affinity
@@ -199,6 +200,10 @@ class RayletService(ChaosPartitionRpc):
         # work and lease grants are shed to other nodes while in-flight +
         # gang-pinned work finishes in the grace window.
         self._draining = False
+        # Delta heartbeat encoder: steady-state beats carry only changed
+        # state; forced full after (re)registration and fences, when the
+        # GCS's view of this node is unknown (core/heartbeat.py).
+        self._hb_codec = HeartbeatCodec()
         # Membership epoch granted at registration; carried on every
         # GCS-bound RPC. When the GCS answers StaleNodeEpochError this
         # incarnation has been fenced (declared dead during a partition):
@@ -2759,6 +2764,7 @@ class RayletService(ChaosPartitionRpc):
                 # into the GCS node record; GCS-initiated drains already
                 # set it there first.
                 stats["draining"] = True
+            send_avail, send_stats = self._hb_codec.encode(avail, stats)
             try:
                 # _FENCED: the GCS declared this node dead while a
                 # partition hid its heartbeats — this incarnation is a
@@ -2766,7 +2772,7 @@ class RayletService(ChaosPartitionRpc):
                 # (never resurrect in place). Not a dict, so it skips the
                 # reply handling below.
                 reply = self._gcs_call_fenced(
-                    "heartbeat", "heartbeat", self.node_id, avail, stats
+                    "heartbeat", "heartbeat", self.node_id, send_avail, send_stats
                 )
                 if isinstance(reply, dict):
                     self._cluster_size = reply.get("nodes", self._cluster_size)
@@ -2788,10 +2794,17 @@ class RayletService(ChaosPartitionRpc):
                         )
                         if isinstance(reg, dict):
                             self.epoch = reg.get("epoch", self.epoch)
+                        # The restarted GCS has no stats for this node:
+                        # the next beat must resend everything.
+                        self._hb_codec.force_full()
             except Exception as e:
                 # Missed heartbeats are how this node gets declared dead:
                 # say so while it is still alive to say anything.
                 _log.debug("heartbeat to GCS failed (retried next tick): %r", e)
+                # The codec advanced its baselines for a beat the GCS
+                # never applied — deltas against them would silently skip
+                # this tick's changes.
+                self._hb_codec.force_full()
 
     def ping(self) -> str:
         return "pong"
@@ -2919,6 +2932,9 @@ class RayletService(ChaosPartitionRpc):
             if isinstance(reg, dict):
                 self.epoch = reg.get("epoch", 0)
                 self._cluster_size = reg.get("nodes", self._cluster_size)
+            # Fresh incarnation: the GCS rebuilt this node's record, so
+            # the first post-rejoin beat must carry full state.
+            self._hb_codec.force_full()
             _log.warning(
                 "node %s rejoined as epoch %s", self.node_id[:12], self.epoch
             )

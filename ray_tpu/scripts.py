@@ -158,13 +158,37 @@ def _connect(args):
     api.init(address=_resolve_address(args), ignore_reinit_error=True)
 
 
+_STATUS_AUTO_SUMMARY = 64  # per-node rows above this need an explicit ask
+
+
 def cmd_status(args) -> None:
     _connect(args)
     from .utils import state
 
     stats = state.cluster_stats()
     print(f"nodes alive: {stats['nodes_alive']}")
-    for n in state.list_nodes():
+    # At scale, the per-node dump is the enemy: ONE summary RPC (O(1)
+    # reply) + an optional bounded node sample replaces pulling and
+    # printing a megabyte table for 1000 nodes.
+    summary = state.node_summary()
+    limit = getattr(args, "limit", None)
+    if getattr(args, "summary", False) or (
+        limit is None and summary["total"] > _STATUS_AUTO_SUMMARY
+    ):
+        print(
+            f"nodes: {summary['total']} total "
+            + " ".join(f"{k}={v}" for k, v in sorted(summary["by_state"].items()))
+        )
+        print(f"  resources: {summary['resources']}")
+        print(f"  available: {summary['available']}")
+        if not getattr(args, "summary", False):
+            print(
+                f"  (per-node rows suppressed at >{_STATUS_AUTO_SUMMARY} "
+                f"nodes; use --limit N for a sample)"
+            )
+        _status_tail(stats, state)
+        return
+    for n in state.list_nodes(limit):
         mark = "up" if n["Alive"] else "DOWN"
         if n["Alive"] and n.get("Draining"):
             mark = "DRAINING"  # preemption notice received; node departing
@@ -207,6 +231,13 @@ def cmd_status(args) -> None:
             f"available={n['Available']} workers={n['Stats'].get('num_workers', 0)}"
             f"{pool_info}{slice_info}"
         )
+    _status_tail(stats, state)
+
+
+def _status_tail(stats, state) -> None:
+    """The node-independent half of `ray-tpu status` (tasks, store,
+    recovery/efficiency/LLM gauges, alerts, errors) — shared by the
+    per-node and summary-only renderings."""
     print(f"tasks: {stats['tasks']}")
     print(f"actors: {stats['actors']}")
     s = stats["store"]
@@ -970,8 +1001,10 @@ def cmd_debug(args) -> None:
         # Concurrent fan-out: every node samples the SAME window (a
         # sequential walk would offset each node's profile by the full
         # duration, defeating cross-node comparison) and the command
-        # returns in ~seconds, not nodes x seconds.
-        with ThreadPoolExecutor(max_workers=max(1, len(alive))) as pool:
+        # returns in ~seconds, not nodes x seconds. Pool bounded: a
+        # thread per node stops scaling around a few hundred nodes
+        # (thread-stack memory + connect storms on one CLI process).
+        with ThreadPoolExecutor(max_workers=min(64, max(1, len(alive)))) as pool:
             for n, fut in [(n, pool.submit(one, n)) for n in alive]:
                 try:
                     res = fut.result()
@@ -1024,19 +1057,31 @@ def cmd_debug(args) -> None:
     )
     dumped = []
     signaled = 0
-    for n in state.list_nodes():
-        if not n.get("Alive"):
-            continue
-        try:
-            res = RpcClient(n["sock"], connect_timeout=5.0).call(
-                "flight_dump", timeout=10.0
-            )
-        except Exception as e:  # noqa: BLE001
-            print(f"warning: node {n['NodeID'][:12]} dump failed: {e}", file=sys.stderr)
-            continue
-        if res.get("path"):
-            dumped.append(res["path"])
-        signaled += res.get("workers_signaled", 0)
+    from concurrent.futures import ThreadPoolExecutor
+
+    alive = [n for n in state.list_nodes() if n.get("Alive")]
+
+    def _dump_one(n):
+        return RpcClient(n["sock"], connect_timeout=5.0).call(
+            "flight_dump", timeout=10.0
+        )
+
+    # Bounded concurrent fan-out: the sequential walk multiplied its 5 s
+    # connect timeout by the node count — at 1000 nodes, over an hour of
+    # worst case for a debug command.
+    with ThreadPoolExecutor(max_workers=min(64, max(1, len(alive)))) as pool:
+        for n, fut in [(n, pool.submit(_dump_one, n)) for n in alive]:
+            try:
+                res = fut.result()
+            except Exception as e:  # noqa: BLE001
+                print(
+                    f"warning: node {n['NodeID'][:12]} dump failed: {e}",
+                    file=sys.stderr,
+                )
+                continue
+            if res.get("path"):
+                dumped.append(res["path"])
+            signaled += res.get("workers_signaled", 0)
     print(
         f"wrote {len(dumped)} flight-recorder dumps "
         f"(+{signaled} workers signaled) under {flight_recorder.flight_dir()}"
@@ -1165,6 +1210,18 @@ def main(argv=None) -> None:
         "--verbose",
         action="store_true",
         help="per-node worker-pool column (ready/target, preforks, hit/miss)",
+    )
+    p.add_argument(
+        "--summary",
+        action="store_true",
+        help="aggregate rollup only, no per-node rows (the sane view at "
+        "hundreds of nodes; auto-engaged above %d nodes)" % _STATUS_AUTO_SUMMARY,
+    )
+    p.add_argument(
+        "--limit",
+        type=int,
+        default=None,
+        help="cap the per-node rows printed (node-id order)",
     )
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_status)

@@ -101,6 +101,10 @@ class RayTpuConfig:
     # --- GCS ---------------------------------------------------------------
     # Periodic snapshot interval for GCS table persistence (0 = every write).
     gcs_snapshot_interval_s: float = _declare("gcs_snapshot_interval_s", 1.0)
+    # Hot-table shard count (nodes/actors/objects each split into N
+    # key-hashed partitions, one lock + one WAL segment per shard).
+    # 1 degenerates to the single-lock layout.
+    gcs_shards: int = _declare("gcs_shards", 8)
 
 
 CONFIG = RayTpuConfig()

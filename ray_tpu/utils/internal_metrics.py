@@ -518,6 +518,26 @@ GCS_PUBSUB_BACKLOG = Gauge(
     "Entries retained across GCS pubsub channel logs",
     component="gcs",
 )
+GCS_SHARD_LOCK_WAIT = Histogram(
+    "raytpu_gcs_shard_lock_wait_ms",
+    "Wait to acquire a GCS hot-table shard lock, by shard index — the "
+    "direct measure of residual contention after key-hash partitioning",
+    component="gcs",
+    tag_keys=("shard",),
+)
+GCS_PUBSUB_DELTAS = Counter(
+    "raytpu_pubsub_deltas_total",
+    "Delta entries delivered to pubsub_poll2 subscribers, by channel",
+    component="gcs",
+    tag_keys=("channel",),
+)
+GCS_PUBSUB_RESYNCS = Counter(
+    "raytpu_pubsub_resyncs_total",
+    "Subscriber resyncs: gap responses (cursor fell behind the retention "
+    "ring) plus snapshot serves, by channel",
+    component="gcs",
+    tag_keys=("channel",),
+)
 # --- object transport / shm store ----------------------------------------
 OBJECT_BYTES_IN = Counter(
     "raytpu_object_bytes_in_total",

@@ -47,6 +47,7 @@ class NodeEventWatcher:
         # need the cumulative view — the node may drain and die between
         # two of their polls.
         self.ever_draining: Set[str] = set()
+        self.resyncs = 0  # times the cursor fell behind the retention ring
         self._events: List[Dict[str, Any]] = []
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -57,14 +58,21 @@ class NodeEventWatcher:
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
-                entries = self._gcs.call(
-                    "pubsub_poll", CHANNEL, self._seq, self._poll_timeout_s,
+                reply = self._gcs.call(
+                    "pubsub_poll2", CHANNEL, self._seq, self._poll_timeout_s,
                     timeout=self._poll_timeout_s + 10.0,
                 )
             except Exception:
                 if self._stop.wait(0.5):
                     return
                 continue
+            entries = reply.get("entries") or []
+            if reply.get("gap"):
+                # Events between the cursor and the ring's head are GONE
+                # (a stalled subscriber at high event rate): rebuild the
+                # current state sets from the node-table snapshot, then
+                # apply whatever the ring still retains normally.
+                entries = self._resync() + entries
             with self._lock:
                 for seq, msg in entries:
                     self._seq = max(self._seq, seq)
@@ -96,6 +104,34 @@ class NodeEventWatcher:
                 if entries:
                     self._event_count += len(entries)
                     self._cond.notify_all()
+
+    def _resync(self) -> List:
+        """Snapshot-then-deltas recovery: missed TRANSITIONS cannot be
+        replayed, but dead/draining are STATE and the node-table snapshot
+        is authoritative for state — rebuild the sets from it, return the
+        ring's retained tail for normal processing. Best-effort: a failed
+        resync just retries on the next gap verdict."""
+        try:
+            snap = self._gcs.call("node_table_snapshot")
+            retained = self._gcs.call("pubsub_poll", CHANNEL, self._seq, 0.0)
+        except Exception:
+            return []
+        with self._lock:
+            self.resyncs += 1
+            for row in snap.get("nodes") or []:
+                nid = row.get("NodeID")
+                if not nid:
+                    continue
+                self.added.add(nid)
+                if not row.get("Alive"):
+                    self.dead.add(nid)
+                    self.draining.discard(nid)
+                elif row.get("Draining"):
+                    self.draining.add(nid)
+                    self.ever_draining.add(nid)
+                else:
+                    self.dead.discard(nid)
+        return retained
 
     def affected(self, node_ids) -> Set[str]:
         """The subset of `node_ids` that is draining or dead."""

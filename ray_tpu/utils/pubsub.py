@@ -22,7 +22,7 @@ queues; the reference's publisher buffers are bounded the same way).
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 
 def _gcs():
@@ -61,9 +61,75 @@ class Subscription:
             self._cursor = entries[-1][0]
         return [m for _, m in entries]
 
+    def poll_deltas(
+        self, timeout: float = 10.0
+    ) -> Tuple[List[Tuple[int, Any]], bool]:
+        """Gap-aware poll (GCS `pubsub_poll2`): returns `(entries, gap)`
+        with entries as (seq, message) pairs. `gap=True` means this
+        cursor fell behind the retention ring and messages were LOST —
+        the caller must resync from an authoritative snapshot (see
+        NodeTableMirror) instead of pretending the stream is contiguous,
+        which is exactly the failure plain poll() hides."""
+        reply = self._gcs_client.call(
+            "pubsub_poll2",
+            self.channel,
+            self._cursor,
+            timeout,
+            timeout=timeout + 10.0,
+        )
+        entries = reply.get("entries") or []
+        if entries:
+            self._cursor = entries[-1][0]
+        return entries, bool(reply.get("gap"))
+
     @property
     def cursor(self) -> int:
         return self._cursor
+
+
+class NodeTableMirror:
+    """Local mirror of the GCS node table fed by the `node_table` delta
+    channel: slim per-node rows (membership + lifecycle state, NOT the
+    per-heartbeat resource/stats churn) applied in seq order, with a
+    snapshot resync whenever the cursor falls behind the retention ring.
+    Steady state costs one small diff per membership CHANGE instead of a
+    full table per poll — the subscriber half of the delta-pubsub
+    design that lets a single GCS feed ~1000 watchers."""
+
+    CHANNEL = "node_table"
+
+    def __init__(self, gcs):
+        self._gcs = gcs
+        self._seq = 0
+        self.nodes: Dict[str, dict] = {}
+        self.resyncs = 0
+        self._resync()
+
+    def _resync(self) -> None:
+        snap = self._gcs.call("node_table_snapshot")
+        self.nodes = {row["NodeID"]: row for row in snap.get("nodes") or []}
+        self._seq = snap.get("seq", 0)
+        self.resyncs += 1
+
+    def poll(self, timeout: float = 1.0) -> int:
+        """Applies pending deltas (long-polling up to `timeout` for the
+        first); resyncs from snapshot on gap. Returns deltas applied."""
+        reply = self._gcs.call(
+            "pubsub_poll2", self.CHANNEL, self._seq, timeout,
+            timeout=timeout + 10.0,
+        )
+        if reply.get("gap"):
+            self._resync()
+            return 0
+        entries = reply.get("entries") or []
+        for seq, row in entries:
+            self._seq = max(self._seq, seq)
+            if isinstance(row, dict) and row.get("op") == "upsert":
+                self.nodes[row["NodeID"]] = row
+        return len(entries)
+
+    def alive(self) -> Set[str]:
+        return {nid for nid, r in self.nodes.items() if r.get("Alive")}
 
 
 def subscribe(channel: str, from_beginning: bool = False) -> Subscription:

@@ -28,14 +28,26 @@ def _gcs():
     return gcs
 
 
-def list_nodes() -> List[Dict[str, Any]]:
+def list_nodes(limit: Optional[int] = None) -> List[Dict[str, Any]]:
     """Nodes with liveness, resources, labels, and store gauges — plus
     membership identity: `Epoch` (the registration epoch the GCS stamped
     on the current incarnation) and `State`, the membership state machine
     label (ALIVE / DRAINING / DEAD / FENCED; a FENCED node is a
     dead-marked incarnation whose RPCs came back after a partition and
-    are being rejected until it re-registers)."""
-    return _gcs().call("list_nodes")
+    are being rejected until it re-registers).
+
+    `limit` bounds the reply (node-id order): at 1000 nodes the full
+    dump is megabytes of per-node stats — callers that only need a
+    sample (or a count, see node_summary) should not pull all of it."""
+    return _gcs().call("list_nodes", limit)
+
+
+def node_summary() -> Dict[str, Any]:
+    """O(1)-sized cluster membership rollup: total/alive/draining
+    counts, nodes by state, and summed resource capacity/availability —
+    what `ray-tpu status` renders at 1000 nodes instead of a full
+    list_nodes dump."""
+    return _gcs().call("node_summary")
 
 
 def list_actors(limit: int = 1000) -> List[Dict[str, Any]]:

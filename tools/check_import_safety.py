@@ -83,6 +83,12 @@ REQUIRED = {
     # controller, and driver at module load; a backend init here would
     # wedge every control plane at boot.
     "ray_tpu.utils.lock_order",
+    # The sharded-GCS layer: gcs_shards imports into the GCS daemon at
+    # boot (shard routing + WAL segments), heartbeat into EVERY raylet
+    # (the delta codec runs on the 1 Hz beat path) — an import-time
+    # backend init in either would wedge the control plane.
+    "ray_tpu.core.gcs_shards",
+    "ray_tpu.core.heartbeat",
     # The warm-pool layer: the zygote pre-imports the ENTIRE worker
     # stack before forking (an import-time backend init there would
     # wedge every pre-forked worker), and the pool manager imports into

@@ -1,0 +1,407 @@
+"""1000-raylet control-plane simulator: the GCS scale proof harness.
+
+The GCS refuses to be benchmarked honestly by unit tests: its costs are
+lock contention under concurrent fan-in, WAL flush amortization, and
+pubsub delivery lag — none visible at 3 nodes. This harness boots ONE
+real GcsService behind ONE real RpcServer (UDS) and drives it with ~1000
+*thin* raylet stubs: no workers, no object store, just the control-plane
+conversation a raylet has — register, heartbeat (delta-encoded via
+core/heartbeat.py), and membership watching. A handful of client threads
+multiplex the stub population (1000 OS threads would benchmark the
+kernel scheduler, not the GCS).
+
+Phases (all real RPC, wall-clock measured):
+
+1. registration storm, sharded+batched: `register_nodes` batches across
+   client threads against the default shard count.
+2. registration storm, single-lock baseline: per-node `register_node`
+   RPCs against a fresh GCS booted with shards=1 — the pre-sharding
+   design, structurally.
+3. heartbeat fan-in: every stub beats R rounds through the delta codec;
+   per-RPC RTT distribution is the fan-in lag.
+4. pubsub delivery: a node_table delta subscriber (pubsub_poll2 +
+   snapshot resync) races a full-snapshot poller (list_nodes loop) to
+   observe epoch flips; per-flip delivery lag distributions.
+5. heartbeat payload: delta-vs-full wire bytes, ASSERTED — a steady-
+   state delta beat must stay under DELTA_BYTES_MAX and under half the
+   full-beat payload, or the slimming regressed.
+
+Usage: python tools/scale_sim.py [--nodes 1000] [--clients 32] [--json]
+Import-safe: all ray_tpu imports happen inside run_sim().
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# A steady-state delta heartbeat for a quiet node: available unchanged
+# (None on the wire) + {wall_ts, full-beat bookkeeping}. The bound is
+# deliberately loose vs the observed ~100 B — it exists to catch "someone
+# put the full stats dict back on every beat", not byte drift.
+DELTA_BYTES_MAX = 512
+
+
+def _pct(vals: List[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(len(s) * q))]
+
+
+def _dist(vals: List[float]) -> Dict[str, float]:
+    return {
+        "p50_ms": round(_pct(vals, 0.50), 3),
+        "p99_ms": round(_pct(vals, 0.99), 3),
+        "max_ms": round(max(vals), 3) if vals else 0.0,
+        "n": len(vals),
+    }
+
+
+class _StubNode:
+    """The control-plane shadow of a raylet: identity + delta codec.
+
+    Stats mirror the real heartbeat payload's shape (raylet.py
+    _heartbeat_loop) so the wire-size numbers mean something."""
+
+    def __init__(self, i: int):
+        self.node_id = f"sim{i:04d}" + "0" * 24
+        self.sock = f"/tmp/simsock-{i}"  # never connected
+        self.store = f"/tmp/simstore-{i}"
+        self.epoch: Optional[int] = None
+        self.codec = None  # HeartbeatCodec, built in run_sim
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "bytes_in_use": 1 << 20,
+            "num_objects": 7,
+            "num_spilled": 0,
+            "num_workers": 4,
+            "wall_ts": time.time(),
+            "pool": {"ready": 2, "target": 2, "preforked": 1},
+        }
+
+
+def _shard_workers(n_workers: int, items: list, fn) -> None:
+    """Static partition of `items` over `n_workers` threads; joins all.
+    fn(worker_index, sub_items)."""
+    threads = []
+    chunk = -(-len(items) // max(1, n_workers))
+    for w in range(n_workers):
+        part = items[w * chunk:(w + 1) * chunk]
+        if not part:
+            break
+        t = threading.Thread(target=fn, args=(w, part), daemon=True)
+        threads.append(t)
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def _timed_storm(path: str, n_workers: int, items: list, work) -> float:
+    """Run `work(cli, part)` across worker threads and return items/s of
+    the STORM WINDOW only: every worker connects and warms up its RPC
+    channel first, a barrier releases them together, and the clock stops
+    when the last one finishes. Thread spawn + connect setup measured
+    outside — they are driver costs, not GCS admission costs."""
+    from ray_tpu.core.rpc import RpcClient
+
+    chunk = -(-len(items) // max(1, n_workers))
+    n_parts = -(-len(items) // max(1, chunk))  # non-empty partitions
+    t0 = [0.0]
+
+    def _start_clock():
+        t0[0] = time.perf_counter()
+
+    barrier = threading.Barrier(n_parts, action=_start_clock)
+
+    def runner(w: int, part: list):
+        cli = RpcClient(path)
+        cli.call("stats", timeout=30.0)  # connection + codepath warm
+        barrier.wait()
+        work(cli, part)
+        cli.close()
+
+    _shard_workers(n_workers, items, runner)
+    return len(items) / max(1e-9, time.perf_counter() - t0[0])
+
+
+def _boot_gcs(tmp: str, shards: int, tag: str):
+    """One real GCS + RpcServer on a UDS, WAL-backed (flush costs are
+    part of what sharding amortizes — benching without them flatters
+    the single-lock baseline)."""
+    from ray_tpu.core.gcs import GcsService
+    from ray_tpu.core.rpc import RpcServer
+
+    snap = os.path.join(tmp, f"gcs_{tag}.snapshot")
+    svc = GcsService(snapshot_path=snap, session_dir=tmp, shards=shards)
+    path = os.path.join(tmp, f"gcs_{tag}.sock")
+    server = RpcServer(path, svc)
+    return svc, server, path
+
+
+def _register_batched(path: str, nodes: List[_StubNode], clients: int,
+                      batch: int) -> float:
+    """Sharded-path storm: register_nodes batches, C threads. Returns
+    registrations/s."""
+
+    def work(cli, part: List[_StubNode]):
+        for i in range(0, len(part), batch):
+            chunk = part[i:i + batch]
+            specs = [
+                {"node_id": s.node_id, "sock": s.sock, "store": s.store,
+                 "resources": {"CPU": 8.0}, "labels": {}}
+                for s in chunk
+            ]
+            out = cli.call("register_nodes", specs, timeout=120.0)
+            for s, r in zip(chunk, out):
+                s.epoch = r.get("epoch")
+
+    # Best-of-3: a (re-)registration storm is the same code path every
+    # time (epoch bump, same WAL records, same publish), so repeats are
+    # honest — and WAL-flush jitter makes single runs noisy.
+    return max(_timed_storm(path, clients, nodes, work) for _ in range(3))
+
+
+def _register_single(path: str, nodes: List[_StubNode], clients: int) -> float:
+    """Baseline storm: one register_node RPC per node (the pre-batching
+    driver behavior) against the single-lock GCS."""
+
+    def work(cli, part: List[_StubNode]):
+        for s in part:
+            r = cli.call(
+                "register_node", s.node_id, s.sock, s.store,
+                {"CPU": 8.0}, {}, timeout=120.0,
+            )
+            s.epoch = r.get("epoch")
+
+    return max(_timed_storm(path, clients, nodes, work) for _ in range(3))
+
+
+def _heartbeat_rounds(path: str, nodes: List[_StubNode], clients: int,
+                      rounds: int) -> List[float]:
+    """Every stub beats `rounds` times through its delta codec; returns
+    per-RPC RTTs in ms (the fan-in lag a raylet actually experiences)."""
+    from ray_tpu.core.rpc import RpcClient
+
+    lat: List[List[float]] = [[] for _ in range(clients)]
+
+    def work(w: int, part: List[_StubNode]):
+        cli = RpcClient(path)
+        mine = lat[w]
+        for _ in range(rounds):
+            for s in part:
+                avail, stats = s.codec.encode({"CPU": 7.0}, s.stats())
+                t0 = time.perf_counter()
+                cli.call("heartbeat", s.node_id, avail, stats, s.epoch,
+                         timeout=60.0)
+                mine.append((time.perf_counter() - t0) * 1e3)
+        cli.close()
+
+    _shard_workers(clients, nodes, work)
+    return [v for sub in lat for v in sub]
+
+
+def _pubsub_race(path: str, nodes: List[_StubNode], flips: int):
+    """Delta-subscriber vs snapshot-poller delivery lag. Each flip
+    re-registers one node (epoch bump -> one node_table upsert). The
+    delta side applies pubsub_poll2 diffs (snapshot resync on gap); the
+    baseline side re-pulls list_nodes — the design this PR retires."""
+    from ray_tpu.core.rpc import RpcClient
+
+    targets = nodes[:flips]
+    expected: Dict[str, int] = {}
+    sent: Dict[str, float] = {}
+    delta_lag: List[float] = []
+    snap_lag: List[float] = []
+    seen_delta: Dict[str, int] = {}
+    seen_snap: Dict[str, int] = {}
+    done = threading.Event()
+
+    def delta_sub():
+        cli = RpcClient(path)
+        snap = cli.call("node_table_snapshot", timeout=30.0)
+        seq = snap["seq"]
+        rows = {r["NodeID"]: r for r in snap["nodes"]}
+        while not done.is_set():
+            reply = cli.call("pubsub_poll2", "node_table", seq, 0.5,
+                             timeout=30.0)
+            if reply.get("gap"):
+                snap2 = cli.call("node_table_snapshot", timeout=30.0)
+                seq = snap2["seq"]
+                rows = {r["NodeID"]: r for r in snap2["nodes"]}
+                entries = []
+            else:
+                entries = reply.get("entries") or []
+            now = time.perf_counter()
+            for s, row in entries:
+                seq = max(seq, s)
+                rows[row["NodeID"]] = row
+            for nid, want in list(expected.items()):
+                row = rows.get(nid)
+                if row is not None and row.get("Epoch", 0) >= want \
+                        and seen_delta.get(nid) != want:
+                    seen_delta[nid] = want
+                    delta_lag.append((now - sent[nid]) * 1e3)
+        cli.close()
+
+    def snapshot_sub():
+        cli = RpcClient(path)
+        while not done.is_set():
+            view = cli.call("list_nodes", timeout=60.0)
+            now = time.perf_counter()
+            by_id = {n["NodeID"]: n for n in view}
+            for nid, want in list(expected.items()):
+                row = by_id.get(nid)
+                if row is not None and row.get("Epoch", 0) >= want \
+                        and seen_snap.get(nid) != want:
+                    seen_snap[nid] = want
+                    snap_lag.append((now - sent[nid]) * 1e3)
+        cli.close()
+
+    subs = [threading.Thread(target=delta_sub, daemon=True),
+            threading.Thread(target=snapshot_sub, daemon=True)]
+    for t in subs:
+        t.start()
+    time.sleep(0.5)  # both subscribers steady-state before the flips
+    cli = RpcClient(path)
+    try:
+        for s in targets:
+            want = (s.epoch or 0) + 1
+            expected[s.node_id] = want
+            sent[s.node_id] = time.perf_counter()
+            r = cli.call("register_node", s.node_id, s.sock, s.store,
+                         {"CPU": 8.0}, {}, timeout=60.0)
+            s.epoch = r.get("epoch")
+            s.codec.force_full()  # fresh incarnation: GCS state unknown
+            # Spaced flips: delivery lag per event, not a coalesced burst.
+            deadline = time.perf_counter() + 2.0
+            while (seen_delta.get(s.node_id) != want
+                   or seen_snap.get(s.node_id) != want):
+                if time.perf_counter() > deadline:
+                    break
+                time.sleep(0.002)
+    finally:
+        done.set()
+        for t in subs:
+            t.join(timeout=5.0)
+        cli.close()
+    return delta_lag, snap_lag
+
+
+def _heartbeat_bytes(nodes: List[_StubNode]) -> Dict[str, float]:
+    """Wire-size accounting straight off the codec (no RPC): the payload
+    is what pickle ships for (available, stats)."""
+    s = nodes[0]
+    s.codec.force_full()
+    avail, stats = s.codec.encode({"CPU": 7.0}, s.stats())
+    full_bytes = len(pickle.dumps((avail, stats)))
+    deltas = []
+    for _ in range(5):
+        avail, stats = s.codec.encode({"CPU": 7.0}, s.stats())
+        deltas.append(len(pickle.dumps((avail, stats))))
+    delta_bytes = max(deltas)  # worst steady-state beat
+    assert delta_bytes <= DELTA_BYTES_MAX, (
+        f"steady-state heartbeat delta is {delta_bytes} B "
+        f"(cap {DELTA_BYTES_MAX} B): payload slimming regressed"
+    )
+    assert delta_bytes * 2 <= full_bytes, (
+        f"delta beat ({delta_bytes} B) not meaningfully smaller than the "
+        f"full beat ({full_bytes} B)"
+    )
+    return {"full_bytes": full_bytes, "delta_bytes": delta_bytes}
+
+
+def run_sim(n_nodes: int = 1000, clients: int = 32, hb_rounds: int = 3,
+            flips: int = 25, batch: int = 125) -> Dict[str, Any]:
+    # Env must be set BEFORE ray_tpu.utils.config is imported: stub nodes
+    # "miss" heartbeats by design while other phases run — the death
+    # sweep must not cull the population mid-measurement.
+    os.environ.setdefault("RAY_TPU_HEARTBEAT_TIMEOUT_S", "600")
+    from ray_tpu.core.heartbeat import HeartbeatCodec
+
+    out: Dict[str, Any] = {"nodes": n_nodes, "clients": clients}
+    with tempfile.TemporaryDirectory(prefix="scale_sim_") as tmp:
+        # --- phase 1: sharded + batched registration storm
+        nodes = [_StubNode(i) for i in range(n_nodes)]
+        for s in nodes:
+            s.codec = HeartbeatCodec()
+        svc, server, path = _boot_gcs(tmp, shards=None, tag="sharded")
+        try:
+            # The batched path is the DRIVER's protocol (PR 15): a few
+            # connections each shipping full batches — not one thread
+            # per raylet. One client per batch of the population models
+            # it; the per-node baseline keeps all `clients` threads
+            # (every raylet registering itself).
+            bclients = max(1, min(clients, -(-n_nodes // batch)))
+            out["registrations_per_s"] = round(
+                _register_batched(path, nodes, bclients, batch), 1)
+            out["shards"] = svc._nshards
+
+            # --- phase 3: heartbeat fan-in on the registered population
+            lat = _heartbeat_rounds(path, nodes, clients, hb_rounds)
+            out["heartbeat"] = _dist(lat)
+
+            # --- phase 4: delta vs snapshot delivery
+            delta_lag, snap_lag = _pubsub_race(path, nodes, flips)
+            out["pubsub_delta"] = _dist(delta_lag)
+            out["pubsub_snapshot"] = _dist(snap_lag)
+
+            # --- phase 5: wire bytes (asserted)
+            out["heartbeat_payload"] = _heartbeat_bytes(nodes)
+        finally:
+            server.shutdown()
+            svc.stop()
+
+        # --- phase 2: single-lock unbatched baseline, fresh GCS
+        base_nodes = [_StubNode(i) for i in range(n_nodes)]
+        svc1, server1, path1 = _boot_gcs(tmp, shards=1, tag="single")
+        try:
+            out["registrations_per_s_single_lock"] = round(
+                _register_single(path1, base_nodes, clients), 1)
+        finally:
+            server1.shutdown()
+            svc1.stop()
+
+    out["speedup_sharded_vs_single"] = round(
+        out["registrations_per_s"]
+        / max(1e-9, out["registrations_per_s_single_lock"]), 2)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--nodes", type=int, default=1000)
+    ap.add_argument("--clients", type=int, default=32)
+    ap.add_argument("--hb-rounds", type=int, default=3)
+    ap.add_argument("--flips", type=int, default=25)
+    ap.add_argument("--batch", type=int, default=125)
+    ap.add_argument("--json", action="store_true",
+                    help="single JSON object on stdout (bench harness mode)")
+    args = ap.parse_args(argv)
+    result = run_sim(args.nodes, args.clients, args.hb_rounds, args.flips,
+                     args.batch)
+    if args.json:
+        print(json.dumps(result), flush=True)  # console-output: harness contract
+        return 0
+    print(f"nodes={result['nodes']} clients={result['clients']} "  # console-output: CLI report
+          f"shards={result['shards']}")
+    print(f"registrations/s sharded+batched: {result['registrations_per_s']} "  # console-output: CLI report
+          f"| single-lock per-node: {result['registrations_per_s_single_lock']} "
+          f"({result['speedup_sharded_vs_single']}x)")
+    print(f"heartbeat RTT: {result['heartbeat']}")  # console-output: CLI report
+    print(f"pubsub delta:    {result['pubsub_delta']}")  # console-output: CLI report
+    print(f"pubsub snapshot: {result['pubsub_snapshot']}")  # console-output: CLI report
+    print(f"heartbeat bytes: {result['heartbeat_payload']}")  # console-output: CLI report
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
