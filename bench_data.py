@@ -1,0 +1,298 @@
+"""Streaming data plane acceptance harness (executor v2).
+
+Four rows, one JSON line each, mirroring the bench_serve contract style
+(reference: release/nightly_tests/dataset/* — streaming-vs-bulk ingest
+comparisons and iter_batches wait-fraction probes):
+
+1. `data_pipeline_streaming_vs_bsp` — a 3-op actor pipeline at
+   saturation, streaming executor (all stages overlapped) vs the
+   batch-windowed BSP path (stage-by-stage materialize). Contract:
+   streaming >= 2x.
+2. `data_queued_bytes_bounded_under_skew` — fast producer into a slow
+   consumer stage under a small per-op byte budget, REAL store sizes
+   (cluster mode). Contract: peak queued bytes bounded well under the
+   pipeline's total footprint, with backpressure engaging.
+3. `data_pool_autoscale_forecast` — a backlogged pooled stage must
+   scale up through the demand-forecast path (warm worker-pool hits as
+   the receipt) and decay back down when a slow consumer idles it.
+4. `data_trainer_channel_ingest_wait` — trainer workers fed over
+   persistent channels vs object-store shard handoff. Contract: channel
+   ingest data_wait < 5% of the training loop.
+
+Usage: python bench_data.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+import ray_tpu as rt
+from ray_tpu import data as rdata
+from ray_tpu.core import runtime_base
+from ray_tpu.utils.config import CONFIG
+
+
+def emit(metric: str, value: float, unit: str, **extra):
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(value, 4),
+                "unit": unit,
+                "vs_baseline": None,
+                **extra,
+            }
+        ),
+        flush=True,
+    )
+
+
+class _SleepStage:
+    """One pipeline stage of pure service time (the saturation workload)."""
+
+    def __init__(self, seconds: float = 0.02):
+        self._seconds = seconds
+
+    def __call__(self, batch):
+        time.sleep(self._seconds)
+        return batch
+
+
+def bench_streaming_vs_bsp(quick: bool) -> None:
+    """Row 1: 3 sleep stages, streaming overlap vs stage-by-stage BSP.
+
+    Each stage serves at the same rate, so ideal streaming time is ~one
+    stage's span while BSP pays all three sequentially (plus a windowed
+    materialize barrier per stage) — the tentpole's >= 2x claim."""
+    n_blocks = 24 if quick else 48
+    stage_s = 0.02
+
+    rt.init(local_mode=True, num_cpus=16)
+    try:
+
+        def streaming_once() -> float:
+            ds = rdata.range(n_blocks * 4, parallelism=n_blocks)
+            for _ in range(3):
+                ds = ds.map_batches(_SleepStage(stage_s), concurrency=2)
+            t0 = time.perf_counter()
+            n = sum(1 for _ in ds.iter_block_refs())
+            assert n == n_blocks
+            return time.perf_counter() - t0
+
+        def bsp_once() -> float:
+            t0 = time.perf_counter()
+            ds = rdata.range(n_blocks * 4, parallelism=n_blocks)
+            for _ in range(3):
+                ds = ds.map_batches(_SleepStage(stage_s), concurrency=2).materialize()
+            assert ds.num_blocks() == n_blocks
+            return time.perf_counter() - t0
+
+        streaming_once(), bsp_once()  # warm actor spawn paths
+        stream_t = min(streaming_once() for _ in range(2))
+        bsp_t = min(bsp_once() for _ in range(2))
+    finally:
+        rt.shutdown()
+
+    ratio = bsp_t / stream_t if stream_t else 0.0
+    emit(
+        "data_pipeline_streaming_vs_bsp",
+        ratio,
+        "x",
+        note=(
+            f"3-op pipeline, {n_blocks} blocks x {stage_s*1000:.0f}ms/stage: "
+            f"streaming={stream_t*1000:.0f}ms bsp={bsp_t*1000:.0f}ms"
+        ),
+    )
+    assert ratio >= 2.0, (
+        f"streaming pipeline only {ratio:.2f}x the batch-windowed path "
+        f"(contract: >= 2x at saturation)"
+    )
+
+
+def bench_bounded_bytes_under_skew(quick: bool) -> None:
+    """Row 2: per-op budgets must bound queued bytes with REAL sizes.
+
+    An expander stage emits ~1 MiB blocks into a 1-way slow stage; with a
+    4 MiB budget the executor may not queue the whole stream (the
+    unbounded-footprint failure the unknown-size fix closes)."""
+    # Not shrunk under --quick: fewer ~1 MiB blocks never overflow the
+    # budget, so backpressure (the thing being proven) would not engage.
+    n_blocks = 16
+    budget = 4 << 20
+
+    rt.init(num_cpus=8)
+    saved = CONFIG.data_op_budget_bytes
+    CONFIG.data_op_budget_bytes = budget
+    try:
+
+        def expand(b):
+            n = len(b["id"])
+            return {"id": b["id"], "x": np.zeros((n, 32_000), dtype=np.float64)}
+
+        ds = (
+            rdata.range(n_blocks * 4, parallelism=n_blocks)
+            .map_batches(expand)
+            .map_batches(_SleepStage(0.05), concurrency=1)
+        )
+        n = sum(1 for _ in ds.iter_block_refs(prefetch=2))
+        assert n == n_blocks
+        ex = ds._last_executors[-1]
+        assert ex._sizing is True, "cluster store must size blocks"
+        peak = ex.stats["peak_queued_bytes"]
+        backpressure = sum(op.backpressure_events for op in ex._ops)
+        total = n_blocks * 4 * 32_000 * 8  # the expander's full footprint
+    finally:
+        CONFIG.data_op_budget_bytes = saved
+        rt.shutdown()
+
+    emit(
+        "data_queued_bytes_bounded_under_skew",
+        peak / (1 << 20),
+        "MiB",
+        note=(
+            f"peak queued vs {total / (1 << 20):.0f} MiB produced under a "
+            f"{budget >> 20} MiB/op budget; {backpressure} backpressure events"
+        ),
+    )
+    assert 0 < peak <= 0.75 * total, (
+        f"peak queued {peak} bytes of {total} produced — the budget did "
+        f"not bound the skewed pipeline"
+    )
+    assert backpressure > 0, "budget never engaged (no backpressure events)"
+
+
+def bench_pool_autoscale(quick: bool) -> None:
+    """Row 3: backlog grows the pool through the forecast path; idleness
+    shrinks it. Warm worker-pool hits are the forecast receipt: the GCS
+    relays `report_demand_forecast(source="data")` into raylet heartbeat
+    pool hints, so the spawn pops a live worker instead of cold-booting."""
+    n_blocks = 40 if quick else 60
+
+    rt.init(num_cpus=8)
+    saved = (CONFIG.data_pool_up_s, CONFIG.data_pool_idle_s)
+    CONFIG.data_pool_up_s = 1.2
+    CONFIG.data_pool_idle_s = 0.4
+    try:
+
+        def warm_hits() -> int:
+            st = runtime_base.maybe_runtime()._raylet.call("debug_state")["pool"]
+            return sum(st.get("hits", {}).values())
+
+        h0 = warm_hits()
+        ds = (
+            rdata.range(n_blocks * 4, parallelism=n_blocks)
+            .map_batches(lambda b: b)
+            .map_batches(_SleepStage(0.08), concurrency=(1, 4))
+        )
+        got = 0
+        peak_size = 0
+        ex = None
+        for _ in ds.iter_block_refs(prefetch=4):
+            got += 1
+            if ex is None:
+                ex = ds._last_executors[-1]
+            peak_size = max(peak_size, ex._ops[-1].pool.size)
+            if got > (n_blocks * 2) // 3:
+                time.sleep(0.15)  # slow-consumer tail idles the pool
+        assert got == n_blocks
+        pool = ex._ops[-1].pool
+        hits = warm_hits() - h0
+    finally:
+        CONFIG.data_pool_up_s, CONFIG.data_pool_idle_s = saved
+        rt.shutdown()
+
+    emit(
+        "data_pool_autoscale_forecast",
+        peak_size,
+        "actors",
+        note=(
+            f"scale_ups={pool.scale_ups} scale_downs={pool.scale_downs} "
+            f"warm_pool_hits={hits} over {n_blocks} blocks"
+        ),
+    )
+    assert pool.scale_ups >= 1, "backlogged pool never scaled up"
+    assert pool.scale_downs >= 1, "idled pool never scaled back down"
+    assert hits > 0, "pool growth took no warm workers (forecast path dead)"
+
+
+def bench_trainer_channel_ingest(quick: bool) -> None:
+    """Row 4: channel-fed trainer ingest must hide the data plane — the
+    data_wait phase stays under 5% of the loop; the object-store handoff
+    path (per-batch pull + rebatch on the worker) is the baseline row."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    rows_total = 2048 if quick else 4096
+
+    def train_loop(config):
+        import time as _t
+
+        import numpy as _np
+
+        from ray_tpu import train
+
+        shard = train.get_dataset_shard("train")
+        rows = 0
+        t0 = _t.perf_counter()
+        for batch in shard.iter_device_batches(batch_size=64, drop_last=False):
+            rows += int(_np.asarray(batch["id"]).shape[0])
+            _t.sleep(0.03)  # simulated train step
+        train.report({"rows": rows, "loop_wall": _t.perf_counter() - t0})
+
+    rt.init(local_mode=True, num_cpus=8)
+    fracs = {}
+    try:
+        for mode in ("object_store", "channel"):
+            trainer = JaxTrainer(
+                train_loop,
+                scaling_config=ScalingConfig(num_workers=2),
+                run_config=RunConfig(
+                    name=f"bench_data_{mode}", storage_path=tempfile.mkdtemp()
+                ),
+                datasets={
+                    "train": rdata.range(rows_total, parallelism=16).map_batches(
+                        lambda b: {"id": b["id"], "x": b["id"] * 2.0}
+                    )
+                },
+                dataset_config=mode,
+            )
+            res = trainer.fit()
+            assert res.metrics["rows"] == rows_total // 2
+            wait = res.metrics["phase_seconds"]["data_wait"]
+            fracs[mode] = wait / res.metrics["loop_wall"]
+    finally:
+        rt.shutdown()
+
+    emit(
+        "data_trainer_channel_ingest_wait",
+        fracs["channel"],
+        "fraction",
+        note=(
+            f"data_wait fraction of train loop: channel={fracs['channel']:.2%} "
+            f"object_store={fracs['object_store']:.2%}"
+        ),
+    )
+    # The object-store row is the reported baseline, not a contract:
+    # local-mode handoff is an in-process lookup, so both paths can hide
+    # the wait on a warm box. The contract is the channel bound itself.
+    assert fracs["channel"] < 0.05, (
+        f"channel ingest data_wait {fracs['channel']:.2%} of the loop "
+        f"(contract: < 5%)"
+    )
+
+
+def main():
+    quick = "--quick" in sys.argv
+    bench_streaming_vs_bsp(quick)
+    bench_bounded_bytes_under_skew(quick)
+    bench_pool_autoscale(quick)
+    bench_trainer_channel_ingest(quick)
+    print("bench_data: all contracts held", flush=True)
+
+
+if __name__ == "__main__":
+    main()

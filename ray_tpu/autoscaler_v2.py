@@ -243,8 +243,11 @@ class InstanceManager:
 
     def set_pending_actors(self, n: int) -> None:
         """Declares imminent actor-launch demand (forecast, not a
-        reservation). Relayed to the GCS on the next reconcile round;
-        TTL-bounded there so a stale forecast decays on its own."""
+        reservation). Relayed to the GCS on the next reconcile round
+        under the "autoscaler" forecast source (the data plane's
+        starved-operator pools declare under "data" directly; the GCS
+        sums sources into each heartbeat's pool_hint); TTL-bounded there
+        so a stale forecast decays on its own."""
         with self._lock:
             self._pending_actors = max(0, int(n))
 
@@ -305,7 +308,11 @@ class InstanceManager:
                 try:
                     # 60 s TTL: pools on a loaded box need tens of
                     # seconds to pre-boot a large fleet's inventory.
-                    self._gcs.call("report_demand_forecast", forecast, 60.0)
+                    # Source-keyed: the data plane's starved-operator
+                    # forecasts ("data") coexist without clobbering.
+                    self._gcs.call(
+                        "report_demand_forecast", forecast, 60.0, "autoscaler"
+                    )
                 except Exception:  # lint: swallow-ok(forecast is an optimization hint; next round retries)
                     pass
                 else:

@@ -106,11 +106,14 @@ class GcsService(ChaosPartitionRpc):
         # that actor's restart path forever.
         self._actor_restarting: Set[str] = set()
         self._stranded_sweep_inflight = False  # one sweep thread at a time
-        # Autoscaler demand forecast (autoscaler_v2 InstanceManager
-        # relays its pending-work estimate): folded into each heartbeat
+        # Demand forecasts, keyed by source: autoscaler_v2's pending-actor
+        # estimate ("autoscaler") and the data plane's starved-operator
+        # pool growth ("data") both land here, summed into each heartbeat
         # reply's pool_hint so raylets pre-size their warm worker pools
-        # BEFORE the launch storm arrives. (value, expires_at_monotonic).
-        self._demand_forecast: Tuple[int, float] = (0, 0.0)
+        # BEFORE the launch storm arrives. The dict is REPLACED wholesale
+        # on every write (never mutated in place) so the heartbeat path
+        # can read it lock-free. {source: (value, expires_at_monotonic)}.
+        self._demand_forecast: Dict[str, Tuple[int, float]] = {}
         # Borrow counts / free tombstones / deferred frees live on the
         # OBJECT's shard (same partition as its location set); only the
         # time-ordered free queue stays on the control lock.
@@ -691,18 +694,23 @@ class GcsService(ChaosPartitionRpc):
         O(cluster) scan."""
         raylet_drained = False
         alive = self._alive_nodes()
-        # Warm-pool demand hint: this node's share of the autoscaler's
-        # pending-work forecast — launches expected but NOT yet
-        # registered (registration consumes the forecast). Deliberately
+        # Warm-pool demand hint: this node's share of the summed demand
+        # forecasts — launches expected but NOT yet registered
+        # (registration consumes the forecast). The autoscaler's
+        # pending-actor storms and the data plane's starved-operator pool
+        # growth are independent sources, so they add. Deliberately
         # excludes already-registered PENDING actors: those are consuming
         # the pool right now, the raylet's local launch-rate EWMA already
         # sees them, and counting them here double-inflated the target
         # right as the storm peaked. Read lock-free BEFORE the shard lock
-        # (the tuple is swapped atomically; gcs.state must never be taken
+        # (the dict is swapped atomically; gcs.state must never be taken
         # while a shard lock is held).
-        fc_n, fc_exp = self._demand_forecast
+        now_mono = time.monotonic()
+        fc_n = sum(
+            n for n, exp in self._demand_forecast.values() if n > 0 and now_mono < exp
+        )
         pool_hint = 0
-        if fc_n > 0 and time.monotonic() < fc_exp and alive > 0:
+        if fc_n > 0 and alive > 0:
             pool_hint = -(-fc_n // alive)  # ceil division
         sh = self._node_shard(node_id)
         with self._locked(sh):
@@ -750,17 +758,24 @@ class GcsService(ChaosPartitionRpc):
             self.report_preemption(node_id, 0.0, "raylet-initiated drain")
         return {"ok": True, "nodes": alive, "pool_hint": pool_hint}
 
-    def report_demand_forecast(self, n: int, ttl_s: float = 15.0) -> bool:
-        """Autoscaler-relayed pending-work forecast (actors expected to
-        launch cluster-wide soon). TTL-bounded: a crashed autoscaler's
-        stale forecast must decay instead of pinning every pool high
-        forever. Each heartbeat reply hands every raylet
-        ceil(n / alive_nodes) as its pool_hint share."""
+    def report_demand_forecast(
+        self, n: int, ttl_s: float = 15.0, source: str = "autoscaler"
+    ) -> bool:
+        """Pending-work forecast from `source` (actors expected to launch
+        cluster-wide soon): autoscaler_v2 relays pending-actor estimates,
+        data/op_pool.py declares starved-operator pool growth. Each
+        source's forecast is independent — a new report REPLACES that
+        source's prior value and TTL only. TTL-bounded: a crashed
+        reporter's stale forecast must decay instead of pinning every
+        pool high forever. Each heartbeat reply hands every raylet
+        ceil(sum / alive_nodes) as its pool_hint share."""
         with self._lock:
-            self._demand_forecast = (
+            fc = dict(self._demand_forecast)
+            fc[str(source)] = (
                 max(0, int(n)),
                 time.monotonic() + max(0.0, float(ttl_s)),
             )
+            self._demand_forecast = fc  # atomic whole-dict swap
         return True
 
     # ---------------------------------------------------- preemption/drain
@@ -1577,16 +1592,25 @@ class GcsService(ChaosPartitionRpc):
                 del self._named[key]
 
     def _consume_forecast(self, n: int) -> None:
-        # Each registration CONSUMES one unit of the autoscaler's
-        # pending-work forecast: the forecast predicts launches that
-        # haven't arrived yet, so once they do, the pools must stop
-        # holding capacity for them (an unconsumed forecast kept
-        # refilling — and CPU-starving — the node straight through
-        # the launch storm it predicted).
+        # Each registration CONSUMES one unit of the pending-work
+        # forecast: the forecast predicts launches that haven't arrived
+        # yet, so once they do, the pools must stop holding capacity for
+        # them (an unconsumed forecast kept refilling — and CPU-starving
+        # — the node straight through the launch storm it predicted).
+        # Sources are drawn down in sorted order — an arbitrary but
+        # deterministic attribution; the pool_hint only ever sees the sum.
         with self._lock:
-            fc_n, fc_exp = self._demand_forecast
-            if fc_n > 0:
-                self._demand_forecast = (max(0, fc_n - n), fc_exp)
+            fc = dict(self._demand_forecast)
+            remaining = int(n)
+            for src in sorted(fc):
+                if remaining <= 0:
+                    break
+                fc_n, fc_exp = fc[src]
+                if fc_n > 0:
+                    take = min(fc_n, remaining)
+                    fc[src] = (fc_n - take, fc_exp)
+                    remaining -= take
+            self._demand_forecast = fc  # atomic whole-dict swap
 
     def _place_actor(
         self,

@@ -12,10 +12,12 @@ from .dataset import (
     read_json,
     read_parquet,
 )
-from .iterator import DataIterator
+from .feed import ChannelDataIterator, ChannelFeed, make_channel_feeds
+from .iterator import DataIterator, SplitStreams
 
 __all__ = [
-    "Block", "BlockAccessor", "Dataset", "DataIterator", "from_items",
-    "from_numpy", "from_pandas", "range", "read_csv", "read_json",
+    "Block", "BlockAccessor", "ChannelDataIterator", "ChannelFeed",
+    "Dataset", "DataIterator", "SplitStreams", "from_items", "from_numpy",
+    "from_pandas", "make_channel_feeds", "range", "read_csv", "read_json",
     "read_parquet",
 ]

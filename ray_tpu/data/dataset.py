@@ -40,6 +40,12 @@ from .datasource import (
     write_parquet_block,
 )
 
+# One nbytes helper for the whole data plane (satellite fix: this used to
+# be duplicated here and in streaming.py with DIFFERENT unknown-size
+# semantics — None here, 0 there; the 0 variant made the executor's byte
+# budget silently undercount in-flight blocks).
+from .streaming import block_nbytes as _block_nbytes
+
 DEFAULT_PARALLELISM = 16
 
 # This module exports a `range(n)` dataset constructor (reference:
@@ -68,7 +74,9 @@ class _Op:
     key: Optional[Any] = None
     descending: bool = False
     seed: Optional[int] = None
-    concurrency: Optional[int] = None  # actor-pool size for map_batches
+    # Actor-pool sizing for map_batches: int = fixed pool, (min, max)
+    # tuple = autoscaling pool (executor v2), None = fuse into tasks.
+    concurrency: Union[int, Tuple[int, int], None] = None
     aggs: Optional[Dict[str, Tuple[str, Optional[str]]]] = None  # groupby
     group_fn: Optional[Callable] = None  # groupby map_groups
     datasets: Optional[List["Dataset"]] = None  # union members
@@ -138,19 +146,6 @@ class ExecStats:
     wall_s: float = 0.0
 
 
-def _block_nbytes(ref) -> Optional[int]:
-    """Size of a locally-present block's framed payload (None if remote or
-    still in flight) — the cheap signal the byte budget adapts on."""
-    from ..core import runtime_base
-
-    rt = runtime_base.maybe_runtime()
-    store = getattr(rt, "_store", None)
-    if store is None or not hasattr(ref, "id"):
-        return None
-    try:
-        return store.raw_size(ref.id())
-    except Exception:
-        return None
 
 
 def _windowed(
@@ -258,10 +253,15 @@ class Dataset:
         *,
         batch_size: Optional[int] = None,
         batch_format: str = "numpy",
-        concurrency: Optional[int] = None,
+        concurrency: Union[int, Tuple[int, int], None] = None,
         **_ignored,
     ) -> "Dataset":
-        """(reference: dataset.py:391)"""
+        """(reference: dataset.py:391)
+
+        `concurrency` selects the actor-pool execution path: an int pins
+        the pool size; a `(min, max)` tuple enables pressure-driven
+        autoscaling between the bounds (executor v2 — the reference's
+        autoscaling actor pool; the v1 executor runs `min` actors)."""
         return self._extended(
             _Op(
                 kind="map_batches",
@@ -357,35 +357,63 @@ class Dataset:
     ) -> Iterator[Any]:
         """The streaming executor: yields refs to output blocks. Chains of
         streamable stages run under a pull-based per-operator state machine
-        (data/streaming.py — per-op in-flight caps, downstream-first
-        scheduling, memory-budget drain mode), so every stage processes
-        different blocks concurrently. Barrier stages (repartition/shuffle/
-        sort/groupby) materialize their input before streaming resumes."""
+        so every stage processes different blocks concurrently. Two
+        generations, selected by RAY_TPU_DATA_EXECUTOR (read per call so
+        benches can A/B in one process):
+
+        - "v2" (default, data/executor.py): autoscaling operator actor
+          pools + per-operator byte budgets with drain-first scheduling;
+        - "v1" (data/streaming.py): fixed pools, single global budget.
+
+        Barrier stages (repartition/shuffle/sort/groupby) materialize
+        their input before streaming resumes."""
+        import os as _os
         import time as _time
 
         _ensure_initialized()
         t0 = _time.perf_counter()
+        use_v2 = (_os.environ.get("RAY_TPU_DATA_EXECUTOR") or "v2") != "v1"
         source, stages = self._plan_stages()
         refs: Iterator[Any] = self._source_iter(source)
 
-        pending_ops: List[Any] = []
+        pending_stages: List[Tuple[str, Any]] = []
+        self._last_executors: List[Any] = []  # introspection (tests/bench)
 
         def flush(refs_in: Iterator[Any]) -> Iterator[Any]:
-            nonlocal pending_ops
-            if not pending_ops:
+            nonlocal pending_stages
+            if not pending_stages:
                 return refs_in
-            from .streaming import StreamingExecutor
+            batch, pending_stages = pending_stages, []
+            if use_v2:
+                from .executor import PipelineExecutor
 
-            ops, pending_ops = pending_ops, []
-            return StreamingExecutor(
-                refs_in, ops, prefetch=max(1, prefetch), memory_budget=memory_budget
-            ).run_iter()
+                ops = [
+                    self._fused_pipeline_op(payload, prefetch)
+                    if kind == "fused"
+                    else self._actor_pool_pipeline_op(payload)
+                    for kind, payload in batch
+                ]
+                ex: Any = PipelineExecutor(
+                    refs_in, ops, prefetch=max(1, prefetch), memory_budget=memory_budget
+                )
+            else:
+                from .streaming import StreamingExecutor
+
+                ops = [
+                    self._fused_stream_op(payload, prefetch)
+                    if kind == "fused"
+                    else self._actor_pool_stream_op(payload)
+                    for kind, payload in batch
+                ]
+                ex = StreamingExecutor(
+                    refs_in, ops, prefetch=max(1, prefetch), memory_budget=memory_budget
+                )
+            self._last_executors.append(ex)
+            return ex.run_iter()
 
         for kind, payload in stages:
-            if kind == "fused":
-                pending_ops.append(self._fused_stream_op(payload, prefetch))
-            elif kind == "map_batches":
-                pending_ops.append(self._actor_pool_stream_op(payload))
+            if kind in ("fused", "map_batches"):
+                pending_stages.append((kind, payload))
             elif kind == "repartition":
                 refs = iter(self._repartition(list(flush(refs)), payload.n))
             elif kind == "shuffle":
@@ -427,6 +455,55 @@ class Dataset:
             cap=max(2, prefetch),
         )
 
+    @staticmethod
+    def _pool_bounds(concurrency) -> Tuple[int, int]:
+        """(min, max) pool size from a map_batches concurrency spec."""
+        if isinstance(concurrency, tuple):
+            lo, hi = concurrency
+            lo = max(1, int(lo))
+            return lo, max(lo, int(hi))
+        n = max(1, int(concurrency or 1))
+        return n, n
+
+    def _fused_pipeline_op(self, ops: List[_Op], prefetch: int):
+        """Executor-v2 fused task stage (stateless submission, same task
+        body as the v1 builder)."""
+        from .executor import PipelineOp
+
+        @api.remote
+        def do_transform(block: Block, ops=ops) -> Block:
+            return _apply_fused(block, ops)
+
+        names = "+".join(o.kind for o in ops)
+        return PipelineOp(
+            f"fused[{names}]",
+            submit=lambda r: do_transform.remote(r),
+            cap=max(2, prefetch),
+        )
+
+    def _actor_pool_pipeline_op(self, op: _Op):
+        """Executor-v2 actor-pool stage: an op_pool.OperatorPool scaling
+        between the declared (min, max) on pressure signals."""
+        import cloudpickle
+
+        from .executor import PipelineOp
+        from .op_pool import OperatorPool
+
+        lo, hi = self._pool_bounds(op.concurrency)
+        actor_cls = api.remote(max_concurrency=2)(_BatchMapActor)
+        blob = cloudpickle.dumps(op.fn)
+        pool = OperatorPool(
+            f"map_batches[pool={lo}..{hi}]",
+            spawn=lambda: actor_cls.remote(blob),
+            min_size=lo,
+            max_size=hi,
+        )
+        return PipelineOp(
+            pool.name,
+            pool=pool,
+            make_call=lambda a, r: a.apply.remote(r, op.batch_size, op.batch_format),
+        )
+
     def _actor_pool_stream_op(self, op: _Op):
         """Actor-pool stage (reference: actor_pool_map_operator.py:34):
         the pool is created when the executor starts the stage and torn
@@ -435,7 +512,7 @@ class Dataset:
 
         from .streaming import StreamOp
 
-        n_actors = max(1, op.concurrency or 1)
+        n_actors, _ = self._pool_bounds(op.concurrency)
         actor_cls = api.remote(max_concurrency=2)(_BatchMapActor)
         blob = cloudpickle.dumps(op.fn)
         state: Dict[str, Any] = {"actors": [], "rr": 0}
@@ -765,7 +842,13 @@ class Dataset:
         equal=True slices shards to identical row counts (dropping the
         remainder) — required for SPMD training where every worker must step
         the same number of batches or a collective hangs. locality_hints is
-        accepted for API parity; the thread-based runtime has no locality."""
+        accepted for API parity; the thread-based runtime has no locality.
+
+        Returns a SplitStreams (a list of DataIterators) whose
+        `.to_channel()` upgrades delivery to persistent cgraph channels:
+        k ChannelFeed handles, shippable to trainer workers / serve
+        replicas, each pumping its shard through a shared-memory ring
+        (data/feed.py) instead of per-block object-store pulls."""
         from .iterator import make_streaming_split
 
         return make_streaming_split(self, n, equal=equal)

@@ -103,12 +103,26 @@ class DataIterator:
         batch_format: str = "numpy",
     ) -> Iterator[Any]:
         """Batches placed on device: numpy -> jax arrays sharded over the
-        mesh's batch axes (the device-feed boundary, SURVEY.md §7)."""
-        from ..parallel.sharding import shard_batch
+        mesh's batch axes (the device-feed boundary, SURVEY.md §7).
 
-        for batch in self.iter_batches(
+        Every host-side pull is bracketed in `train.phase("data_wait")`,
+        so input-pipeline stalls land in the goodput/MFU telemetry
+        (raytpu_train_phase_time_ms + the per-report phase_seconds
+        breakdown) automatically — previously only training loops that
+        wrapped the pull by hand were accounted. A no-op outside a
+        training session."""
+        from ..parallel.sharding import shard_batch
+        from ..train.session import phase as _train_phase
+
+        it = self.iter_batches(
             batch_size=batch_size, batch_format=batch_format, drop_last=drop_last
-        ):
+        )
+        _SENTINEL = object()
+        while True:
+            with _train_phase("data_wait"):
+                batch = next(it, _SENTINEL)
+            if batch is _SENTINEL:
+                return
             if mesh is not None:
                 yield shard_batch(batch, mesh)
             else:
@@ -175,20 +189,91 @@ class SplitCoordinator:
             return list(self._epochs[epoch][shard])
 
 
-def make_streaming_split(dataset, n: int, *, equal: bool = True) -> List[DataIterator]:
-    import cloudpickle
+class SplitStreams(list):
+    """The list of per-worker DataIterators `streaming_split` returns,
+    plus the channel-delivery upgrade: `.to_channel()` swaps the
+    object-store pull path for persistent channel feeds (data/feed.py) —
+    one ChannelFeed handle per shard, shippable to the consuming actor."""
 
-    api_remote = api.remote(max_concurrency=max(2, n))(SplitCoordinator)
-    coordinator = api_remote.remote(cloudpickle.dumps(dataset), n, equal)
+    def __init__(self, iterators, dataset, n: int, equal: bool):
+        super().__init__(iterators)
+        self._dataset = dataset
+        self._n = n
+        self._equal = equal
+        self._coordinator: Optional[Callable[[], Any]] = None
+
+    def prepare_shipping(self) -> None:
+        """Forces the shared SplitCoordinator actor into existence before
+        the per-shard iterators are pickled to remote workers — otherwise
+        each unpickled copy would lazily create its OWN coordinator and
+        the epoch-coordination guarantee (same epoch => same data) dies."""
+        if self._coordinator is not None:
+            self._coordinator()
+
+    def to_channel(self, capacity: Optional[int] = None) -> List[Any]:
+        from .feed import _FEED_CAPACITY, make_channel_feeds
+
+        return make_channel_feeds(
+            self._dataset,
+            self._n,
+            equal=self._equal,
+            capacity=capacity or _FEED_CAPACITY,
+        )
+
+
+class _LazyCoordinator:
+    """Creates the shared SplitCoordinator actor on first use, not at
+    split time: a split immediately upgraded with .to_channel() (whose
+    BlockFeeder owns its own coordinator state) must not leak an idle
+    actor per call. Picklable — and pickling FORCES creation, so every
+    shipped shard iterator keeps pointing at the ONE coordinator (each
+    copy lazily creating its own would break same-epoch-same-data)."""
+
+    def __init__(self, dataset, n: int, equal: bool):
+        self._dataset = dataset
+        self._n = n
+        self._equal = equal
+        self._lock = threading.Lock()
+        self._coord: Any = None
+
+    def __call__(self):
+        import cloudpickle
+
+        with self._lock:
+            if self._coord is None:
+                cls = api.remote(max_concurrency=max(2, self._n))(SplitCoordinator)
+                self._coord = cls.remote(
+                    cloudpickle.dumps(self._dataset), self._n, self._equal
+                )
+            return self._coord
+
+    def __getstate__(self):
+        self()
+        return {"coord": self._coord, "n": self._n, "equal": self._equal}
+
+    def __setstate__(self, state):
+        self._dataset = None  # remote copies only ever talk to the actor
+        self._n = state["n"]
+        self._equal = state["equal"]
+        self._lock = threading.Lock()
+        self._coord = state["coord"]
+
+
+def make_streaming_split(dataset, n: int, *, equal: bool = True) -> "SplitStreams":
+    coordinator = _LazyCoordinator(dataset, n, equal)
     epochs = [0] * n
 
     def make_fn(shard: int) -> Callable[[], Iterator[Any]]:
         def fn():
             epoch = epochs[shard]
             epochs[shard] += 1
-            refs = api.get(coordinator.get_shard_blocks.remote(shard, epoch))
+            refs = api.get(coordinator().get_shard_blocks.remote(shard, epoch))
             yield from refs
 
         return fn
 
-    return [DataIterator(make_fn(i)) for i in range(n)]
+    streams = SplitStreams(
+        [DataIterator(make_fn(i)) for i in range(n)], dataset, n, equal
+    )
+    streams._coordinator = coordinator
+    return streams

@@ -50,6 +50,7 @@ KIND_PREFIXES = {
     "chan",      # core/channel.py reads/writes/timeouts
     "chaos",     # chaos controller injections
     "coll",      # collective rendezvous/ops
+    "data",      # streaming data plane: pool scaling + backpressure edges
     "incident",  # GCS trigger bus: incident open/staged lifecycle
     "lock",      # utils/lock_order.py order-cycle / long-hold reports
     "net",       # chaos network partitions (install/heal/blocked sends)

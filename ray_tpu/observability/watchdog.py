@@ -122,6 +122,20 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
         "description": "serve time-to-first-token p99 over its SLO",
     },
     {
+        # A burst of backpressure edges is normal (that's the mechanism
+        # working); a SUSTAINED rate means an operator's byte budget is
+        # chronically undersized for the pipeline's skew and the source
+        # is spending its life gated instead of reading.
+        "name": "data_backpressure",
+        "metric": "raytpu_data_backpressure_total",
+        "stat": "rate",
+        "op": ">",
+        "threshold": 5.0,
+        "window_s": 30.0,
+        "for_s": 5.0,
+        "description": "data pipeline persistently backpressured: an operator budget is undersized",
+    },
+    {
         # KV-pool exhaustion is observable as its symptom: the LLM
         # engine rejecting admissions with backpressure. A sustained
         # shed rate means the page pool is undersized for the offered

@@ -328,6 +328,29 @@ def _status_tail(stats, state) -> None:
             f"kv_pages={int(llm['used'])}/{int(llm['total'])} "
             f"prefix_hits={hit_pct:.0f}% shed={int(llm['shed'])}"
         )
+    # Streaming data plane: live operator pools, bytes queued at operator
+    # inputs, and backpressure edges. Only printed when a pipeline has
+    # reported (some data metric is non-zero).
+    dp = {"pool": 0.0, "queued": 0.0, "bp": 0.0, "tasks": 0.0}
+    dp_names = {
+        "raytpu_data_op_pool_size": "pool",
+        "raytpu_data_op_queued_bytes": "queued",
+        "raytpu_data_backpressure_total": "bp",
+        "raytpu_data_op_tasks_total": "tasks",
+    }
+    try:
+        for m in metrics_records:
+            label = dp_names.get(m.get("name"))
+            if label:
+                dp[label] += float(m.get("value") or 0.0)
+    except Exception:
+        dp = {}
+    if dp and any(dp.values()):
+        print(
+            f"data plane: pool_actors={int(dp['pool'])} "
+            f"queued={int(dp['queued'])}B "
+            f"backpressure_edges={int(dp['bp'])} tasks={int(dp['tasks'])}"
+        )
     # Active SLO alerts (observability/watchdog.py): the reactive layer's
     # current verdict on the cluster.
     try:

@@ -6,12 +6,13 @@ from .api import delete, get_app_handle, run, shutdown
 from .batching import batch, get_multiplexed_model_id, multiplexed
 from .deployment import Application, AutoscalingConfig, Deployment, DeploymentConfig, deployment
 from .handle import DeploymentHandle, DeploymentResponse, start_proxy, stop_proxy
+from .ingest import FeatureTable
 
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
-    "DeploymentHandle", "DeploymentResponse", "batch", "delete", "deployment",
-    "get_app_handle", "get_multiplexed_model_id", "llm", "multiplexed", "run",
-    "shutdown", "start_proxy", "stop_proxy",
+    "DeploymentHandle", "DeploymentResponse", "FeatureTable", "batch",
+    "delete", "deployment", "get_app_handle", "get_multiplexed_model_id",
+    "llm", "multiplexed", "run", "shutdown", "start_proxy", "stop_proxy",
 ]
 
 

@@ -13,6 +13,7 @@ from .session import (
     drain_requested,
     get_checkpoint,
     get_context,
+    get_dataset_shard,
     get_session,
     phase,
     report,
@@ -33,7 +34,8 @@ __all__ = [
     "Checkpoint", "CheckpointManager", "StorageContext", "load_pytree",
     "save_pytree", "CheckpointConfig", "FailureConfig", "RunConfig",
     "ScalingConfig", "configure_telemetry", "drain_requested",
-    "get_checkpoint", "get_context", "get_session", "phase", "report",
+    "get_checkpoint", "get_context", "get_dataset_shard", "get_session",
+    "phase", "report",
     "JaxTrainer", "Result", "WorkerGroup", "get_mesh",
     "elastic_checkpoint", "zero",
 ]

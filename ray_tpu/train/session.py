@@ -67,6 +67,11 @@ class TrainSession:
         self._peak_flops: Optional[float] = None
         self._phase_seconds: Dict[str, float] = {}
         self._phase_lock = threading.Lock()
+        # This rank's dataset shards (name -> DataIterator), resolved by
+        # worker_group.start_training from the trainer's streaming_split
+        # (object-store pulls) or .to_channel() feeds (ring delivery);
+        # read via train.get_dataset_shard().
+        self.dataset_shards: Dict[str, Any] = {}
 
     # ------------------------------------------------------------ user API
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
@@ -268,6 +273,19 @@ def drain_requested() -> bool:
     TrainSession.drain_requested."""
     s = get_session()
     return s.drain_requested() if s else False
+
+
+def get_dataset_shard(name: str = "train"):
+    """This rank's shard of a trainer-attached dataset (the
+    `ray.train.get_dataset_shard` analogue): a DataIterator — iterate with
+    `iter_batches()` / `iter_device_batches()`. With the trainer's
+    `dataset_config="channel"`, the iterator reads a persistent channel
+    feed (blocks pushed by a BlockFeeder actor) instead of pulling from
+    the object store. None outside a session or for an unknown name."""
+    s = get_session()
+    if s is None:
+        return None
+    return s.dataset_shards.get(name)
 
 
 def phase(name: str):

@@ -93,6 +93,7 @@ class JaxTrainer:
         scaling_config: Optional[ScalingConfig] = None,
         run_config: Optional[RunConfig] = None,
         datasets: Optional[Dict[str, Any]] = None,
+        dataset_config: str = "object_store",
         resume_from_checkpoint: Optional[Checkpoint] = None,
     ):
         self._train_loop = train_loop_per_worker
@@ -100,6 +101,16 @@ class JaxTrainer:
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self._datasets = dict(datasets or {})
+        # "object_store": each rank pulls its shard's blocks by ref;
+        # "channel": each rank ingests over a persistent channel feed
+        # (data/feed.py — a BlockFeeder actor pushes blocks through a
+        # shared-memory ring, overlapping the object-plane fetch with the
+        # consumer's step so data_wait collapses).
+        if dataset_config not in ("object_store", "channel"):
+            raise ValueError(
+                f"dataset_config must be 'object_store' or 'channel', got {dataset_config!r}"
+            )
+        self._dataset_config = dataset_config
         self._resume_from = resume_from_checkpoint
 
     # ------------------------------------------------------------------ fit
@@ -322,6 +333,17 @@ class JaxTrainer:
             if aid in ids and nid
         }
 
+    def _split_shards(self, ds: Any, ws: int) -> List[Any]:
+        """One coordinated equal split of `ds` into ws per-rank handles:
+        ChannelFeed handles (dataset_config="channel") or plain shard
+        iterators (pre-shipped coordinator, so every rank shares ONE
+        SplitCoordinator actor)."""
+        split = ds.streaming_split(ws)
+        if self._dataset_config == "channel":
+            return split.to_channel()
+        split.prepare_shipping()
+        return list(split)
+
     def _use_distributed(self, world_size: Optional[int] = None) -> bool:
         """Multi-host rendezvous requires process-isolated workers (one jax
         runtime per worker); the thread-based local runtime shares one
@@ -441,6 +463,14 @@ class JaxTrainer:
             config = dict(self._config)
             if self._datasets:
                 config["__datasets__"] = self._datasets
+                # Per-rank shards (train.get_dataset_shard resolves them
+                # worker-side): one coordinated streaming_split per
+                # dataset per attempt, so an elastic restart re-splits at
+                # the new world size.
+                config["__dataset_shards__"] = {
+                    ds_name: self._split_shards(ds, ws)
+                    for ds_name, ds in self._datasets.items()
+                }
             api.get(
                 [
                     w.start_training.remote(

@@ -108,6 +108,20 @@ class _TrainWorker:
             self._error = e
             raise
         session.mesh = self._mesh
+        # Resolve this rank's dataset shards: a ChannelFeed handle becomes
+        # a live ChannelDataIterator HERE (the reader ring must be hosted
+        # by the consuming process), plain split iterators pass through.
+        # Copy-not-pop: in the thread-based local runtime every worker
+        # receives the SAME config dict object, so a pop by rank 0 would
+        # starve the other ranks.
+        shard_lists = config.get("__dataset_shards__") or {}
+        for ds_name, shards in shard_lists.items():
+            shard = shards[self.rank]
+            session.dataset_shards[ds_name] = (
+                shard.iterator() if hasattr(shard, "iterator") else shard
+            )
+        if shard_lists:
+            config = {k: v for k, v in config.items() if k != "__dataset_shards__"}
         if self._drain_flag:
             # A drain notice landed before the session existed (restart
             # races): the new session starts pre-drained.

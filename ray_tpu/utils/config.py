@@ -98,6 +98,24 @@ class RayTpuConfig:
     # failure-recovery check, so this bounds retry/reconstruction latency.
     object_wait_poll_s: float = _declare("object_wait_poll_s", 2.0)
 
+    # --- data plane --------------------------------------------------------
+    # Streaming executor generation: "v2" (operator actor pools with
+    # pressure-driven autoscaling + per-op byte budgets) or "v1" (the
+    # single global-budget scheduler). Dataset.iter_block_refs re-reads
+    # the env var at call time so benches can A/B in one process.
+    data_executor: str = _declare("data_executor", "v2")
+    # Per-operator queued-bytes budget (executor v2): an operator whose
+    # input queue holds more than this backpressures its upstream
+    # instead of accumulating blocks.
+    data_op_budget_bytes: int = _declare("data_op_budget_bytes", 64 << 20)
+    # Operator actor-pool autoscaling bounds/cadence: hard cap on any
+    # pool, how long "backlogged + downstream starved" must persist
+    # before a scale-up, and how long a surplus actor must sit idle
+    # before scale-down.
+    data_pool_max: int = _declare("data_pool_max", 8)
+    data_pool_up_s: float = _declare("data_pool_up_s", 0.2)
+    data_pool_idle_s: float = _declare("data_pool_idle_s", 2.0)
+
     # --- GCS ---------------------------------------------------------------
     # Periodic snapshot interval for GCS table persistence (0 = every write).
     gcs_snapshot_interval_s: float = _declare("gcs_snapshot_interval_s", 1.0)

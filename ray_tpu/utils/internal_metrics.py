@@ -719,6 +719,25 @@ DATA_ROWS = Counter(
     component="data",
     tag_keys=("operator",),
 )
+DATA_OP_POOL_SIZE = Gauge(
+    "raytpu_data_op_pool_size",
+    "Live actors in an operator's autoscaling pool (executor v2)",
+    component="data",
+    tag_keys=("operator",),
+)
+DATA_OP_QUEUED_BYTES = Gauge(
+    "raytpu_data_op_queued_bytes",
+    "Object-store bytes queued at an operator's input (executor v2)",
+    component="data",
+    tag_keys=("operator",),
+)
+DATA_BACKPRESSURE = Counter(
+    "raytpu_data_backpressure_total",
+    "Times an operator was gated because its downstream exceeded its "
+    "byte budget (one count per blocked->unblocked transition edge)",
+    component="data",
+    tag_keys=("operator",),
+)
 TRAIN_REPORTS = Counter(
     "raytpu_train_reports_total",
     "train.report() calls (one per training step loop iteration)",

@@ -1,0 +1,335 @@
+"""Streaming data plane (executor v2): operator pools, per-op byte
+budgets with drain-first scheduling, consumer-stall backpressure, and
+channel delivery into Train and Serve (data/executor.py, data/op_pool.py,
+data/feed.py, serve/ingest.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rt():
+    import ray_tpu as rtpu
+
+    rtpu.shutdown()
+    rtpu.init(local_mode=True, num_cpus=8)
+    yield rtpu
+    rtpu.shutdown()
+
+
+@pytest.fixture
+def v2(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_DATA_EXECUTOR", "v2")
+
+
+# --------------------------------------------------------------- selection
+def _pipeline(data):
+    return (
+        data.range(60, parallelism=6)
+        .map(lambda r: {"id": r["id"] + 1})
+        .filter(lambda r: r["id"] % 2 == 0)
+    )
+
+
+def test_executor_parity_v1_v2(rt, monkeypatch):
+    """Both executor generations produce identical results; the env knob
+    selects the generation per iter_block_refs call."""
+    from ray_tpu import data
+    from ray_tpu.data.executor import PipelineExecutor
+    from ray_tpu.data.streaming import StreamingExecutor
+
+    monkeypatch.setenv("RAY_TPU_DATA_EXECUTOR", "v1")
+    ds = _pipeline(data)
+    v1_rows = sorted(r["id"] for r in ds.take_all())
+    assert isinstance(ds._last_executors[-1], StreamingExecutor)
+
+    monkeypatch.setenv("RAY_TPU_DATA_EXECUTOR", "v2")
+    ds = _pipeline(data)
+    v2_rows = sorted(r["id"] for r in ds.take_all())
+    assert isinstance(ds._last_executors[-1], PipelineExecutor)
+    assert v1_rows == v2_rows == [i + 1 for i in range(60) if (i + 1) % 2 == 0]
+
+
+def test_pool_bounds_from_concurrency():
+    from ray_tpu.data.dataset import Dataset
+
+    assert Dataset._pool_bounds(None) == (1, 1)
+    assert Dataset._pool_bounds(3) == (3, 3)
+    assert Dataset._pool_bounds((2, 5)) == (2, 5)
+    assert Dataset._pool_bounds((0, 5)) == (1, 5)  # floor of 1
+
+
+# ----------------------------------------------------------- operator pool
+def test_operator_pool_scale_ladder(monkeypatch):
+    """Forecast-first scale-up (declare at pressure onset, spawn after the
+    sustain window, doubling to the cap) and idle decay back to min."""
+    from ray_tpu.data import op_pool
+
+    declared = []
+    monkeypatch.setattr(
+        op_pool, "_declare_forecast", lambda n, ttl_s=30.0: declared.append(n)
+    )
+    pool = op_pool.OperatorPool(
+        "p", spawn=object, min_size=1, max_size=4, up_s=0.5, idle_s=1.0
+    )
+    pool.start()
+    assert pool.size == 1
+
+    # Pressure onset: forecast declared immediately, NO spawn yet.
+    pool.update_pressure(True, True, now=10.0)
+    assert pool.size == 1 and declared == [1]
+    # Sustained past up_s: spawn lands (growth = current size, doubling).
+    pool.update_pressure(True, True, now=10.6)
+    assert pool.size == 2 and pool.scale_ups == 1
+    pool.update_pressure(True, True, now=11.0)
+    assert declared == [1, 2]  # next window forecasts the next double
+    pool.update_pressure(True, True, now=11.6)
+    assert pool.size == 4 and pool.scale_ups == 2
+    # At max_size further pressure is a no-op.
+    pool.update_pressure(True, True, now=12.2)
+    assert pool.size == 4 and pool.scale_ups == 2
+
+    # Idle decay: one actor per idle_s interval, stopping at min_size.
+    pool.update_pressure(False, False, now=20.0)
+    assert pool.size == 4  # idle clock just started
+    pool.update_pressure(False, False, now=21.1)
+    assert pool.size == 3 and pool.scale_downs == 1
+    pool.update_pressure(False, False, now=22.2)
+    assert pool.size == 2
+    pool.update_pressure(False, False, now=23.3)
+    assert pool.size == 1
+    pool.update_pressure(False, False, now=24.4)
+    assert pool.size == 1  # floor
+
+
+def test_operator_pool_blip_tolerance(monkeypatch):
+    """A single calm tick inside a pressure streak (scheduler race) must
+    not reset the sustain clock; a real calm stretch must."""
+    from ray_tpu.data import op_pool
+
+    monkeypatch.setattr(op_pool, "_declare_forecast", lambda n, ttl_s=30.0: None)
+    pool = op_pool.OperatorPool(
+        "p", spawn=object, min_size=1, max_size=4, up_s=0.5, idle_s=10.0
+    )
+    pool.start()
+
+    pool.update_pressure(True, True, now=10.0)
+    pool.update_pressure(False, True, now=10.2)  # blip: within 0.25s grace
+    pool.update_pressure(True, True, now=10.6)  # streak alive: 0.6s >= up_s
+    assert pool.size == 2 and pool.scale_ups == 1
+
+    pool.update_pressure(True, True, now=20.0)
+    pool.update_pressure(False, False, now=20.4)  # real calm: past the grace
+    pool.update_pressure(True, True, now=20.5)  # streak restarted at 20.5
+    pool.update_pressure(True, True, now=20.9)  # only 0.4s — no spawn
+    assert pool.size == 2 and pool.scale_ups == 1
+
+
+def test_map_batches_tuple_concurrency_builds_autoscaling_pool(rt, v2):
+    from ray_tpu import data
+
+    class AddOffset:
+        def __init__(self):
+            self.offset = 100
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.offset}
+
+    ds = data.range(40, parallelism=4).map_batches(AddOffset, concurrency=(1, 3))
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == [i + 100 for i in range(40)]
+    pool = ds._last_executors[-1]._ops[-1].pool
+    assert pool is not None
+    assert (pool.min_size, pool.max_size) == (1, 3)
+
+
+# ------------------------------------------------------- byte accounting
+def test_unknown_size_counts_at_observed_mean():
+    """The unknown-size-counts-as-0 fix: blocks whose payload cannot be
+    sized yet charge at the stream's observed mean, never 0."""
+    from ray_tpu.data.streaming import BlockSizeEstimator
+
+    est = BlockSizeEstimator()
+    assert est.estimate(object()) == 0  # nothing observed yet
+    est.observe(10)
+    est.observe(20)
+    assert est.mean == 15
+    assert est.estimate(object()) == 15  # unsizable ref -> mean, not 0
+
+
+def test_sizing_skipped_without_store(rt, v2):
+    """local_mode has no sizable store and the stock nbytes helper, so v2
+    skips byte accounting entirely (the overhead fast path)."""
+    from ray_tpu import data
+
+    ds = data.range(100, parallelism=4).map_batches(lambda b: b)
+    assert ds.count() == 100
+    ex = ds._last_executors[-1]
+    assert ex._sizing is False
+    assert ex.stats["peak_queued_bytes"] == 0
+
+
+def test_bounded_queued_bytes_under_skew(rt, v2, monkeypatch):
+    """A slow middle operator must backpressure the fast source through
+    its byte budget: queued bytes stay bounded well under the pipeline's
+    total instead of accumulating every produced block."""
+    from ray_tpu import data
+    from ray_tpu.data import streaming
+    from ray_tpu.utils.config import CONFIG
+
+    block = 4 << 20  # every block "weighs" 4 MiB
+    monkeypatch.setattr(streaming, "block_nbytes", lambda ref: block)
+    monkeypatch.setattr(CONFIG, "data_op_budget_bytes", 8 << 20)
+
+    class SlowPass:
+        def __call__(self, batch):
+            time.sleep(0.03)
+            return batch
+
+    n_blocks = 32
+    ds = (
+        data.range(n_blocks * 8, parallelism=n_blocks)
+        .map_batches(lambda b: b)
+        .map_batches(SlowPass, concurrency=1)
+    )
+    total = sum(1 for _ in ds.iter_block_refs(prefetch=2))
+    assert total == n_blocks
+
+    ex = ds._last_executors[-1]
+    assert ex._sizing is True
+    peak = ex.stats["peak_queued_bytes"]
+    assert 0 < peak <= (n_blocks * block) // 2, (
+        f"peak queued {peak} bytes — budget did not bound the skewed op"
+    )
+    assert sum(op.backpressure_events for op in ex._ops) > 0
+    assert ex._queued_total == 0  # every charge matched by a discharge
+
+
+def test_consumer_stall_backpressures_source(rt, v2):
+    """A stalled consumer must stall source pulls (bounded prefetch), and
+    releasing the stall must drain the full pipeline."""
+    from ray_tpu import data
+
+    n_blocks = 40
+    ds = data.range(n_blocks * 4, parallelism=n_blocks).map_batches(lambda b: b)
+    it = ds.iter_block_refs(prefetch=2)
+    first = next(it)
+    assert first is not None
+    time.sleep(0.4)  # consumer stalled; executor keeps scheduling
+    ex = ds._last_executors[-1]
+    pulled_while_stalled = ex.stats["source_pulled"]
+    assert pulled_while_stalled <= 12, (
+        f"source pulled {pulled_while_stalled} blocks into a stalled "
+        "pipeline — consumer backpressure is not reaching the source"
+    )
+    rest = sum(1 for _ in it)
+    assert 1 + rest == n_blocks
+    assert ex.stats["source_pulled"] == n_blocks
+
+
+# -------------------------------------------------------- channel delivery
+def test_streaming_split_to_channel(rt):
+    from ray_tpu import data
+
+    ds = data.range(120, parallelism=6)
+    feeds = ds.streaming_split(2).to_channel()
+    assert len(feeds) == 2
+
+    seen = []
+    for feed in feeds:
+        batches = list(feed.iterator().iter_batches(batch_size=30))
+        assert [len(b["id"]) for b in batches] == [30, 30]
+        seen.extend(int(v) for b in batches for v in b["id"])
+    assert sorted(seen) == list(range(120))
+
+
+def test_streaming_split_shards_ship_one_coordinator(rt):
+    import cloudpickle
+
+    from ray_tpu import data
+
+    split = data.range(80, parallelism=4).streaming_split(2)
+    split.prepare_shipping()
+    shards = cloudpickle.loads(cloudpickle.dumps(list(split)))
+    seen = []
+    for shard in shards:
+        for batch in shard.iter_batches(batch_size=40):
+            seen.extend(int(v) for v in batch["id"])
+    assert sorted(seen) == list(range(80))
+
+
+@pytest.mark.parametrize("dataset_config", ["object_store", "channel"])
+def test_trainer_dataset_ingest(rt, tmp_path, dataset_config):
+    """End-to-end: Trainer splits the dataset per rank, workers resolve
+    their shard via train.get_dataset_shard, and iter_device_batches
+    brackets every pull in the data_wait phase."""
+    from ray_tpu import data
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def train_loop(config):
+        import numpy as np
+
+        from ray_tpu import train
+
+        shard = train.get_dataset_shard("train")
+        rows = 0
+        for batch in shard.iter_device_batches(batch_size=32, drop_last=False):
+            rows += int(np.asarray(batch["id"]).shape[0])
+        train.report({"rows": rows})
+
+    trainer = JaxTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name=f"ingest_{dataset_config}", storage_path=str(tmp_path)),
+        datasets={"train": data.range(256, parallelism=8)},
+        dataset_config=dataset_config,
+    )
+    result = trainer.fit()
+    assert result.metrics["rows"] == 128  # equal split of 256 over 2 ranks
+    assert result.metrics["phase_seconds"]["data_wait"] > 0
+
+
+def test_trainer_rejects_unknown_dataset_config():
+    from ray_tpu.train import JaxTrainer
+
+    with pytest.raises(ValueError, match="dataset_config"):
+        JaxTrainer(lambda config: None, dataset_config="teleport")
+
+
+def test_serve_feature_table_ingest(rt):
+    from ray_tpu import data
+    from ray_tpu.serve import FeatureTable
+
+    ds = data.range(100, parallelism=4).map_batches(
+        lambda b: {"id": b["id"], "feat": b["id"] * 0.5}
+    )
+    feed = ds.streaming_split(1).to_channel()[0]
+    table = FeatureTable(feed, key="id", batch_size=32, continuous=False)
+    try:
+        assert table.wait_for_epoch(timeout=30.0), table.stats()
+        row = table.lookup(42)
+        assert row is not None and row["feat"] == pytest.approx(21.0)
+        assert table.lookup(12345) is None
+        st = table.stats()
+        assert st["rows"] == 100 and st["error"] is None
+    finally:
+        table.close()
+
+
+def test_feature_table_lru_eviction(rt):
+    from ray_tpu import data
+    from ray_tpu.serve import FeatureTable
+
+    ds = data.range(50, parallelism=2)
+    feed = ds.streaming_split(1).to_channel()[0]
+    table = FeatureTable(feed, key="id", max_rows=10, continuous=False)
+    try:
+        assert table.wait_for_epoch(timeout=30.0), table.stats()
+        st = table.stats()
+        assert st["rows"] == 10 and st["rows_ingested"] == 50
+        assert table.lookup(49) is not None  # newest kept
+        assert table.lookup(0) is None  # oldest evicted
+    finally:
+        table.close()

@@ -107,6 +107,15 @@ REQUIRED = {
     "ray_tpu.serve.llm.model",
     "ray_tpu.serve.llm.deployment",
     "ray_tpu.serve.llm.feed",
+    # The streaming data plane: executor + op_pool import into every
+    # driver that iterates a Dataset, feed into every trainer worker /
+    # serve replica consuming a channel split — an import-time backend
+    # init in any of them would wedge ingest across the fleet.
+    "ray_tpu.data.streaming",
+    "ray_tpu.data.executor",
+    "ray_tpu.data.op_pool",
+    "ray_tpu.data.feed",
+    "ray_tpu.serve.ingest",
 }
 
 
